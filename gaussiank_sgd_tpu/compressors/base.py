@@ -1,0 +1,143 @@
+"""Core types and shared machinery for gradient compressors.
+
+Reference parity: ``compression.py`` in sb17v/GaussianK-SGD (SURVEY.md §2 C1).
+The reference exposes per-tensor ``compress(tensor, name, sigma_scale, ratio)``
+methods plus a class-level residual store for error feedback. Here every
+compressor is a *pure function* from ``(accumulated_gradient, hyper, rng)`` to
+``(CompressedGrad, residual)`` so the whole thing jits and shards; the residual
+store lives in the train state as a sharded device array, never in Python
+globals (SURVEY.md §2.3, §7 stage 1).
+
+Design constraints imposed by XLA (static shapes):
+
+* Every compressor returns *exactly* ``k`` packed ``(index, value)`` pairs,
+  ``k = max(1, ceil(density * numel))`` computed statically at trace time.
+* Selection that would return more than ``k`` entries is truncated
+  deterministically by **lowest flat index first** (documented tie-breaking,
+  SURVEY.md §7 hard part 1); fewer than ``k`` entries are padded with
+  ``(index=0, value=0)`` pairs, which are no-ops under scatter-add
+  decompression.
+* The error-feedback residual zeroes exactly the entries that were actually
+  packed (sent), so ``sent ⊎ residual == acc`` holds elementwise even under
+  truncation/padding.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class CompressedGrad(NamedTuple):
+    """A fixed-size packed sparse gradient.
+
+    ``indices`` are flat int32 indices into the (flattened) gradient buffer,
+    ``values`` the corresponding entries. Padding slots hold ``(0, 0.0)``:
+    harmless under scatter-*add* decompression.
+    """
+
+    indices: jax.Array  # int32[k]
+    values: jax.Array   # float[k]
+
+    @property
+    def k(self) -> int:
+        return self.indices.shape[-1]
+
+
+class CompressResult(NamedTuple):
+    compressed: CompressedGrad
+    residual: jax.Array      # same shape as input acc; EF carry-over
+    num_selected: jax.Array  # int32 scalar: how many entries crossed threshold
+                             # (before truncation to k) — observability parity
+                             # with the reference's logged selection counts.
+
+
+# A compressor is (acc_flat, k, rng, hyper...) -> CompressResult.  Hyper-params
+# are bound by the registry factory (see registry.py).
+CompressorFn = Callable[..., CompressResult]
+
+
+def k_for(numel: int, density: float) -> int:
+    """Static top-k size for a tensor: max(1, ceil(density * numel)).
+
+    Mirrors the reference's per-tensor k computation (SURVEY.md §2.3).
+    """
+    return max(1, int(math.ceil(float(density) * numel)))
+
+
+def pack_by_mask(acc: jax.Array, mask: jax.Array, k: int) -> CompressResult:
+    """Pack entries of ``acc`` where ``mask`` is True into exactly ``k`` slots.
+
+    O(n) with no sort: a cumulative sum of the mask assigns each selected entry
+    its destination slot; entries ranked >= k are dropped (lowest-index-first
+    truncation) and remain in the residual. This is the shape-static TPU
+    analogue of the reference's ``nonzero``-based mask selection
+    (SURVEY.md §2.3 "select by mask, no sort").
+    """
+    n = acc.shape[0]
+    mask = mask.astype(jnp.int32)
+    pos = jnp.cumsum(mask) - 1                      # rank of each selected entry
+    sent = (mask == 1) & (pos < k)                  # actually transmitted
+    slot = jnp.where(sent, pos, k)                  # k == out-of-range -> dropped
+    idx = jnp.zeros((k,), jnp.int32).at[slot].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    val = jnp.zeros((k,), acc.dtype).at[slot].set(acc, mode="drop")
+    residual = jnp.where(sent, jnp.zeros_like(acc), acc)
+    return CompressResult(CompressedGrad(idx, val), residual, jnp.sum(mask))
+
+
+def pack_by_threshold(acc: jax.Array, threshold: jax.Array, k: int) -> CompressResult:
+    """Select |acc| > threshold and pack into exactly k slots (see pack_by_mask)."""
+    return pack_by_mask(acc, jnp.abs(acc) > threshold, k)
+
+
+def decompress(compressed: CompressedGrad, numel: int,
+               dtype=jnp.float32) -> jax.Array:
+    """Scatter a packed sparse gradient back to a dense flat buffer.
+
+    Padding slots (index 0, value 0) add zero, so they are no-ops. When the
+    same index appears from several workers the contributions *sum*, matching
+    the reference's decompress-then-sum allgather semantics (SURVEY.md §3.1).
+    """
+    dense = jnp.zeros((numel,), dtype)
+    return dense.at[compressed.indices].add(compressed.values.astype(dtype))
+
+
+def bisect_threshold(abs_acc: jax.Array, k: int, t0: jax.Array,
+                     num_iters: int = 10,
+                     tol: float = 0.05) -> jax.Array:
+    """Refine a selection threshold so that ``|{|x| > t}| ≈ k``.
+
+    Starts from an analytic estimate ``t0`` (e.g. the Gaussian tail-CDF
+    estimate) and runs a fixed number of bisection steps on ``[0, max|x|]`` —
+    the jit-friendly equivalent of the reference's ≤10 multiplicative
+    threshold-adjustment iterations (SURVEY.md §2.3 "GaussianK threshold
+    selection"). Stops moving once the count is within ``tol·k`` of target.
+    """
+    hi0 = jnp.max(abs_acc)
+    lo0 = jnp.zeros_like(hi0)
+    t0 = jnp.clip(t0, lo0, hi0)
+    k_arr = jnp.asarray(k, jnp.int32)
+    # never accept a zero-selection threshold: floor((1-tol)*k) is 0 at k=1,
+    # which would let small tensors (biases at low density) send nothing
+    lo_tol = jnp.maximum(1, jnp.floor((1.0 - tol) * k)).astype(jnp.int32)
+    hi_tol = jnp.ceil((1.0 + tol) * k).astype(jnp.int32)
+
+    def body(_, carry):
+        t, lo, hi = carry
+        cnt = jnp.sum(abs_acc > t).astype(jnp.int32)
+        within = (cnt >= lo_tol) & (cnt <= hi_tol)
+        # count too high -> threshold too low -> move lo up; and vice versa.
+        new_lo = jnp.where(cnt > k_arr, t, lo)
+        new_hi = jnp.where(cnt > k_arr, hi, t)
+        new_t = 0.5 * (new_lo + new_hi)
+        t = jnp.where(within, t, new_t)
+        lo = jnp.where(within, lo, new_lo)
+        hi = jnp.where(within, hi, new_hi)
+        return t, lo, hi
+
+    t, _, _ = jax.lax.fori_loop(0, num_iters, body, (t0, lo0, hi0))
+    return t

@@ -1,0 +1,44 @@
+"""Exact compressors: ``none`` (dense) and ``topk``.
+
+Reference parity: ``NoneCompressor`` and ``TopKCompressor`` in
+``compression.py`` (SURVEY.md §2 C1, §2.3). ``topk`` is the accuracy-reference
+compressor: exact top-k of |acc| per tensor, with error feedback.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import CompressedGrad, CompressResult
+
+
+def none_compress(acc: jax.Array, k: int,
+                  rng: Optional[jax.Array] = None) -> CompressResult:
+    """Dense pass-through ("none"): every entry is sent, residual is zero.
+
+    ``k`` is ignored (the dense path communicates the full buffer via psum in
+    practice — see parallel/trainstep.py — but the packed form is still valid
+    so that density=1.0 tests can flow through the sparse path).
+    """
+    n = acc.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return CompressResult(CompressedGrad(idx, acc), jnp.zeros_like(acc),
+                          jnp.asarray(n, jnp.int32))
+
+
+def topk_compress(acc: jax.Array, k: int,
+                  rng: Optional[jax.Array] = None) -> CompressResult:
+    """Exact top-k by magnitude via ``lax.top_k`` (sorted, deterministic).
+
+    ``lax.top_k`` breaks ties by lowest index, matching the documented
+    tie-breaking of the mask-packing path (compressors/base.py).
+    """
+    _, idx = jax.lax.top_k(jnp.abs(acc), k)
+    idx = idx.astype(jnp.int32)
+    val = acc[idx]
+    residual = acc.at[idx].set(0.0)
+    return CompressResult(CompressedGrad(idx, val), residual,
+                          jnp.asarray(k, jnp.int32))

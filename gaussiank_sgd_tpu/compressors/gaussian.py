@@ -1,0 +1,61 @@
+"""GaussianK: analytic Gaussian-tail threshold estimation + mask selection.
+
+Reference parity: ``GaussianCompressor`` in ``compression.py``
+(SURVEY.md §2 C1, §2.3 "GaussianK threshold selection"), the headline
+contribution of the reference (Shi et al., arXiv:1911.08772): model the
+error-feedback-accumulated gradient as N(mu, sigma^2), derive the selection
+threshold from the inverse Gaussian tail CDF so that P(|x| > t) ~= density,
+then refine with a bounded number of adjustment iterations. Cost is O(n)
+reductions + a mask — no sort — which is exactly what the TPU VPU wants; the
+fused single-pass version lives in ops/pallas_select.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from scipy.special import ndtri  # host-side: threshold quantile is a
+                                 # compile-time constant (density is static)
+
+from .base import CompressResult, bisect_threshold, pack_by_threshold
+
+
+def gaussian_threshold_estimate(acc: jax.Array, density: float,
+                                sigma_scale: Optional[float] = None) -> jax.Array:
+    """Initial threshold t0 = |mu| + s * sigma.
+
+    ``s`` comes from the two-sided Gaussian tail quantile
+    ``s = Phi^{-1}(1 - density/2)`` when ``sigma_scale`` is None (density is a
+    static Python float, so this is a compile-time constant); the reference's
+    CLI-exposed ``--sigma-scale`` knob (default 2.5, SURVEY.md §2.3) overrides
+    it when given.
+    """
+    if sigma_scale is None:
+        s = float(ndtri(1.0 - min(max(density, 1e-12), 0.5) / 2.0))
+    else:
+        s = float(sigma_scale)
+    mu = jnp.mean(acc)
+    sigma = jnp.std(acc)
+    return jnp.abs(mu) + s * sigma
+
+
+def gaussiank_compress(acc: jax.Array, k: int,
+                       rng: Optional[jax.Array] = None,
+                       *, density: float = 0.001,
+                       sigma_scale: Optional[float] = None,
+                       refine_iters: int = 10) -> CompressResult:
+    """Gaussian-threshold selection packed to exactly k entries.
+
+    1. t0 from the Gaussian tail estimate (O(n) mean/std reductions);
+    2. <= ``refine_iters`` bisection refinements of t toward count ~= k
+       (the reference's multiplicative adjustment loop, made jit-shaped);
+    3. mask-select |acc| > t and pack the first k by index order
+       (pack_by_threshold documents truncation/padding and keeps the EF
+       residual exact).
+    """
+    abs_acc = jnp.abs(acc)
+    t0 = gaussian_threshold_estimate(acc, density, sigma_scale)
+    t = bisect_threshold(abs_acc, k, t0, num_iters=refine_iters)
+    return pack_by_threshold(acc, t, k)

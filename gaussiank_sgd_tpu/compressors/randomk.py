@@ -1,0 +1,55 @@
+"""RandomK / RandomKEC: uniformly random index selection.
+
+Reference parity: ``RandomKCompressor`` / ``RandomKECCompressor`` in
+``compression.py`` (SURVEY.md §2 C1, §2.3). The reference seeds all workers
+identically so the random index sets align across ranks; in this framework the
+train step is a single SPMD program, so every data-parallel shard traces the
+same PRNG key by construction and alignment is automatic.
+
+``randomk`` sends the randomly chosen entries of the *raw* gradient with no
+error feedback (residual = remainder is discarded, matching the reference
+variant without EC); ``randomkec`` keeps the un-sent mass as an EF residual.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import CompressedGrad, CompressResult
+
+
+def _random_indices(rng: jax.Array, n: int, k: int) -> jax.Array:
+    """k distinct random flat indices, without an O(n log n) full sort.
+
+    Draw one uniform key per element and take ``lax.top_k`` over the keys:
+    equivalent to sampling k indices without replacement. top_k is O(n log k)
+    and TPU-friendly; RandomK is not the hot compressor so this is fine
+    (GaussianK exists precisely to avoid per-step top-k on |grad|).
+    """
+    keys = jax.random.uniform(rng, (n,))
+    _, idx = jax.lax.top_k(keys, k)
+    return idx.astype(jnp.int32)
+
+
+def randomk_compress(acc: jax.Array, k: int,
+                     rng: Optional[jax.Array] = None) -> CompressResult:
+    """RandomK without error compensation: residual is zero (mass discarded)."""
+    assert rng is not None, "randomk requires a PRNG key"
+    idx = _random_indices(rng, acc.shape[0], k)
+    val = acc[idx]
+    return CompressResult(CompressedGrad(idx, val), jnp.zeros_like(acc),
+                          jnp.asarray(k, jnp.int32))
+
+
+def randomkec_compress(acc: jax.Array, k: int,
+                       rng: Optional[jax.Array] = None) -> CompressResult:
+    """RandomK with error compensation: un-sent entries stay in the residual."""
+    assert rng is not None, "randomkec requires a PRNG key"
+    idx = _random_indices(rng, acc.shape[0], k)
+    val = acc[idx]
+    residual = acc.at[idx].set(0.0)
+    return CompressResult(CompressedGrad(idx, val), residual,
+                          jnp.asarray(k, jnp.int32))

@@ -1,0 +1,74 @@
+"""Compressor registry — parity with the reference's ``compressors`` dict.
+
+Reference parity: the module-level registry in ``compression.py`` mapping
+``{'none','topk','gaussian','randomk','randomkec','dgcsampling','redsync',
+'redsynctrim'}`` to compressor classes (SURVEY.md §2 C1). Here each entry is a
+:class:`CompressorSpec` that binds hyper-parameters into a uniform pure
+function ``fn(acc_flat, k, rng) -> CompressResult`` plus the static metadata
+the train step needs (does it consume a PRNG key; how many packed slots does a
+nominal k produce — RedSync's acceptance band packs into 2k).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional
+
+from .base import CompressResult
+from .exact import none_compress, topk_compress
+from .gaussian import gaussiank_compress
+from .randomk import randomk_compress, randomkec_compress
+from .sampling import dgc_compress, redsync_compress, redsynctrim_compress
+
+
+class CompressorSpec(NamedTuple):
+    name: str
+    fn: Callable[..., CompressResult]   # (acc, k, rng) -> CompressResult
+    requires_rng: bool
+    uses_error_feedback: bool
+    # Packed buffer slots produced for a nominal k (redsync packs 2k).
+    # ``None`` for the dense 'none' compressor, whose packed size is the
+    # tensor's numel, not a function of k — consumers must take the dense
+    # path (psum) instead of pre-sizing sparse buffers for it.
+    out_k: Optional[Callable[[int], int]]
+
+
+def get_compressor(name: str, *, density: float = 0.001,
+                   sigma_scale: Optional[float] = None) -> CompressorSpec:
+    """Build a compressor spec with hyper-parameters bound.
+
+    ``density`` and ``sigma_scale`` mirror the reference CLI flags
+    ``--density`` / ``--sigma-scale`` (SURVEY.md §2 C6).
+    """
+    name = "none" if name is None else name.lower()
+    if name in ("none", "dense"):
+        # out_k is declared None-like here on purpose: the dense compressor
+        # packs numel slots, not k, so buffer sizing must come from the tensor
+        # (see CompressorSpec.out_k docstring).
+        return CompressorSpec("none", none_compress, False, False, None)
+    if name == "topk":
+        return CompressorSpec("topk", topk_compress, False, True, lambda k: k)
+    if name in ("gaussian", "gaussiank"):
+        fn = functools.partial(gaussiank_compress, density=density,
+                               sigma_scale=sigma_scale)
+        return CompressorSpec("gaussian", fn, False, True, lambda k: k)
+    if name == "randomk":
+        return CompressorSpec("randomk", randomk_compress, True, False,
+                              lambda k: k)
+    if name == "randomkec":
+        return CompressorSpec("randomkec", randomkec_compress, True, True,
+                              lambda k: k)
+    if name == "dgcsampling":
+        fn = functools.partial(dgc_compress, density=density)
+        return CompressorSpec("dgcsampling", fn, True, True, lambda k: k)
+    if name == "redsync":
+        return CompressorSpec("redsync", redsync_compress, False, True,
+                              lambda k: 2 * k)
+    if name == "redsynctrim":
+        return CompressorSpec("redsynctrim", redsynctrim_compress, False, True,
+                              lambda k: k)
+    raise ValueError(f"unknown compressor {name!r}; known: {sorted(NAMES)}")
+
+
+NAMES = ("none", "topk", "gaussian", "randomk", "randomkec", "dgcsampling",
+         "redsync", "redsynctrim")

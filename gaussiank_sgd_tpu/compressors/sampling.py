@@ -1,0 +1,94 @@
+"""Sampling / iterative-threshold compressors: DGC sampling and RedSync.
+
+Reference parity (SURVEY.md §2 C1, §2.3):
+
+* ``DGCSamplingCompressor`` — Deep Gradient Compression (Lin et al.):
+  estimate the top-k threshold from the exact top-k of a small (~1%) random
+  sample, then mask-select against that threshold.
+* ``RedSyncCompressor`` / ``RedSyncTrimCompressor`` — RedSync (Fang et al.):
+  iterative threshold bisection moving ratio bounds until the selected count
+  lands in [k, 2k]; the ``trim`` variant then trims to exactly k.
+
+Both end in the shared fixed-shape packing (compressors/base.py) so they jit.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import CompressResult, k_for, pack_by_threshold
+
+
+def dgc_compress(acc: jax.Array, k: int,
+                 rng: Optional[jax.Array] = None,
+                 *, density: float = 0.001,
+                 sample_ratio: float = 0.01) -> CompressResult:
+    """DGC: threshold = (density * sample_size)-th largest |value| of a sample.
+
+    The sample is drawn with replacement (cheap gather) — fine for threshold
+    *estimation*; the actual selection runs over the full tensor.
+    """
+    assert rng is not None, "dgcsampling requires a PRNG key"
+    n = acc.shape[0]
+    abs_acc = jnp.abs(acc)
+    num_samples = max(k, min(n, int(math.ceil(sample_ratio * n))))
+    sample_idx = jax.random.randint(rng, (num_samples,), 0, n)
+    sample = abs_acc[sample_idx]
+    k_sample = max(1, int(math.ceil(density * num_samples)))
+    top_vals, _ = jax.lax.top_k(sample, k_sample)
+    threshold = top_vals[-1]
+    # Strict > would drop the threshold entry itself; nudge down so the
+    # sampled k-th largest is included, as in the reference's >= semantics.
+    threshold = jnp.nextafter(threshold, jnp.zeros_like(threshold))
+    return pack_by_threshold(acc, threshold, k)
+
+
+def _redsync_threshold(abs_acc: jax.Array, k: int,
+                       num_iters: int = 20) -> jax.Array:
+    """Bisection until |{|x| > t}| ∈ [k, 2k], the RedSync acceptance band."""
+    lo = jnp.zeros((), abs_acc.dtype)
+    hi = jnp.max(abs_acc)
+    k_lo = jnp.asarray(k, jnp.int32)
+    k_hi = jnp.asarray(2 * k, jnp.int32)
+
+    def body(_, carry):
+        t, lo, hi = carry
+        cnt = jnp.sum(abs_acc > t).astype(jnp.int32)
+        ok = (cnt >= k_lo) & (cnt <= k_hi)
+        new_lo = jnp.where(cnt > k_hi, t, lo)
+        new_hi = jnp.where(cnt < k_lo, t, hi)
+        new_t = 0.5 * (new_lo + new_hi)
+        return (jnp.where(ok, t, new_t), jnp.where(ok, lo, new_lo),
+                jnp.where(ok, hi, new_hi))
+
+    t, _, _ = jax.lax.fori_loop(0, num_iters, body,
+                                (0.5 * hi, lo, hi))
+    return t
+
+
+def redsync_compress(acc: jax.Array, k: int,
+                     rng: Optional[jax.Array] = None) -> CompressResult:
+    """RedSync: accept any count in [k, 2k]; pack into a 2k-entry buffer.
+
+    The wider buffer preserves the reference's semantics of sending *up to* 2k
+    entries instead of spending more bisection iterations; padding slots are
+    scatter-add no-ops.
+    """
+    t = _redsync_threshold(jnp.abs(acc), k)
+    return pack_by_threshold(acc, t, 2 * k)
+
+
+def redsynctrim_compress(acc: jax.Array, k: int,
+                         rng: Optional[jax.Array] = None) -> CompressResult:
+    """RedSync-trim: same threshold search, then trim to exactly k entries.
+
+    Trimming keeps the k lowest-index selected entries (the documented
+    truncation rule of pack_by_threshold); trimmed entries remain in the EF
+    residual, so no gradient mass is lost.
+    """
+    t = _redsync_threshold(jnp.abs(acc), k)
+    return pack_by_threshold(acc, t, k)

@@ -1,0 +1,17 @@
+"""Mesh, collectives, and the fused data-parallel train step.
+
+TPU-native replacement for the reference's Horovod/NCCL/mpi4py communication
+stack (SURVEY.md §2 C2-C4, §2.1).
+"""
+
+from .collectives import (dense_allreduce, hierarchical_sparse_allgather_sum,
+                          sparse_allgather_sum)
+from .mesh import (batch_sharded, data_parallel_mesh, hierarchical_dp_mesh,
+                   maybe_initialize_distributed, replicated, shard_batch)
+
+__all__ = [
+    "batch_sharded", "data_parallel_mesh", "dense_allreduce",
+    "hierarchical_dp_mesh", "hierarchical_sparse_allgather_sum",
+    "maybe_initialize_distributed", "replicated", "shard_batch",
+    "sparse_allgather_sum",
+]

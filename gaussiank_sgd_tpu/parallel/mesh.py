@@ -1,0 +1,99 @@
+"""Device mesh construction from the TPU slice topology.
+
+Reference parity: rank discovery and process-group setup in the reference come
+from MPI/Horovod environment variables (``hvd.init()``, ``MPI.COMM_WORLD`` —
+SURVEY.md §2.1, §3.1). TPU-native, the slice topology *is* the communicator:
+``jax.devices()`` enumerates every chip in the slice (after
+``jax.distributed.initialize()`` on multi-host), and a
+``jax.sharding.Mesh`` over them replaces ranks, comms groups, and host files.
+XLA lowers collectives over the mesh onto ICI (intra-slice) / DCN
+(inter-slice) links — the NCCL/OpenMPI role in the reference (SURVEY.md §5
+"Distributed comm backend").
+
+Axis convention:
+  * ``dp``  — data parallelism (the reference's only strategy, SURVEY.md §2.2)
+  * ``ici_dp`` x ``dcn_dp`` — optional 2D split of dp so the sparse allgather
+    rides ICI within a slice with only the cross-slice hop on DCN.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def maybe_initialize_distributed() -> None:
+    """Initialize multi-host JAX if launched as part of a multi-process job.
+
+    Safe to call unconditionally: a single-process run (including the CPU test
+    mesh and the single-chip bench) is a no-op. This replaces the reference's
+    ``hvd.init()`` / ``MPI_Init`` (SURVEY.md §3.1 step 1).
+    """
+    try:
+        jax.distributed.initialize()
+    except Exception:
+        # Single-process run (no cluster autodetected / no coordinator
+        # address) or already initialized — both are fine; multi-host TPU
+        # pods autodetect the coordinator from slice metadata and succeed.
+        pass
+
+
+def data_parallel_mesh(num_devices: Optional[int] = None,
+                       devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D data-parallel mesh over all (or the first ``num_devices``) chips.
+
+    The reference's ``-np P`` / ``nworkers`` (SURVEY.md §2 C6) maps to the
+    size of this mesh's ``dp`` axis.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        if num_devices > len(devs):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devs)}")
+        devs = devs[:num_devices]
+    return Mesh(np.asarray(devs), ("dp",))
+
+def hierarchical_dp_mesh(ici_size: int,
+                         dcn_size: int,
+                         devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """2-D (dcn_dp, ici_dp) mesh for multi-slice data parallelism.
+
+    Keeps the heavy sparse allgather on the fast ICI axis; only the final
+    cross-slice reduction crosses DCN — the TPU analogue of the reference's
+    hierarchical NCCL-within-node / MPI-across-nodes layout (``nwpernode``,
+    SURVEY.md §2 C6).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    want = ici_size * dcn_size
+    if want > len(devs):
+        raise ValueError(
+            f"requested {ici_size}x{dcn_size}={want} devices, have {len(devs)}")
+    devs = devs[:want]
+    arr = np.asarray(devs).reshape(dcn_size, ici_size)
+    return Mesh(arr, ("dcn_dp", "ici_dp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding for model/optimizer state: replicated across dp."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axes=None) -> NamedSharding:
+    """Sharding for a batch: leading dim split across the data-parallel axes.
+
+    Defaults to *all* mesh axes, which is correct for both the 1-D ``('dp',)``
+    mesh and the hierarchical ``('dcn_dp', 'ici_dp')`` mesh — every axis of
+    both is data parallelism.
+    """
+    axes = tuple(mesh.axis_names) if axes is None else axes
+    return NamedSharding(mesh, P(axes))
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host batch onto the mesh with the leading dim sharded over dp."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, batch_sharded(mesh)), batch)

@@ -1,0 +1,31 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+SURVEY.md §4: the reference had no test suite and could not test multi-node
+logic without a cluster. TPU-native makes that cheap — every distributed test
+here runs under ``--xla_force_host_platform_device_count=8`` so 8-way DP,
+sparse allgather, EF state, and mesh logic are unit-testable with no hardware.
+This must run before jax initializes, hence the top of conftest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+# The environment's sitecustomize registers an 'axon' backend factory that
+# proxies to a remote TPU tunnel and gets initialized even under
+# JAX_PLATFORMS=cpu. Tests must never depend on tunnel health: drop the
+# remote factories before any backend is initialized so the whole suite runs
+# on the local virtual 8-device CPU platform.
+for _name in ("axon", "tpu"):
+    _xb._backend_factories.pop(_name, None)
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+jax.config.update("jax_num_cpu_devices", 8)
