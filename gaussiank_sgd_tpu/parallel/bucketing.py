@@ -1,0 +1,96 @@
+"""Static gradient bucketing (tensor fusion) over the flat parameter space.
+
+Reference parity: the gradient-bucketing / tensor-fusion layer of
+``hv_distributed_optimizer.py`` (SURVEY.md §2 C2, §2.3 "Gradient bucketing"):
+small per-layer gradients are merged before compress+communicate so launch
+latency amortizes. In the reference this is a runtime concern (Horovod fusion
+buffers, hook-order-dependent merging). On TPU it is a *compile-time plan*:
+the whole gradient pytree is raveled into one flat buffer, and buckets are
+just static ``(offset, size, k)`` slices of it. Per-bucket selection keeps the
+reference's per-tensor/per-group k semantics; the packed outputs of all
+buckets are concatenated so the exchange is still ONE ``all_gather`` per step
+regardless of bucket count (SURVEY.md §7 design stance — no handles, no
+fusion-buffer runtime).
+
+Three policies, mirroring reference behaviors:
+  * ``bucket_size=None``  — single whole-model bucket (fusion to the limit;
+    the TPU-idiomatic default).
+  * ``bucket_size=B``     — greedy merge of consecutive tensors (ravel order)
+    until a bucket holds >= B elements (the reference's size-threshold
+    fusion).
+  * ``bucket_size=0``     — one bucket per parameter tensor (the reference's
+    un-fused per-tensor hook path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..compressors.base import k_for
+
+
+class Bucket(NamedTuple):
+    offset: int  # start into the flat gradient buffer
+    size: int    # number of elements
+    k: int       # packed slots selected from this bucket (nominal, pre out_k)
+
+
+class BucketPlan(NamedTuple):
+    """A static partition of the flat gradient space into compression units."""
+
+    buckets: Tuple[Bucket, ...]
+    total_numel: int
+
+    @property
+    def total_k(self) -> int:
+        return sum(b.k for b in self.buckets)
+
+
+def leaf_sizes(params: Any) -> List[int]:
+    """Numels of the pytree leaves in ``ravel_pytree`` order."""
+    return [int(jnp.size(x)) for x in jax.tree_util.tree_leaves(params)]
+
+
+def make_bucket_plan(sizes: Sequence[int], density: float,
+                     bucket_size: Optional[int] = None,
+                     min_k: int = 1) -> BucketPlan:
+    """Partition tensors (given by ``sizes``, in flat order) into buckets.
+
+    ``k`` per bucket is ``max(min_k, ceil(density * bucket_numel))`` — the
+    same per-unit rule the reference applies per tensor (SURVEY.md §2.3).
+    """
+    sizes = [int(s) for s in sizes]
+    total = sum(sizes)
+    if total == 0:
+        raise ValueError("empty parameter pytree")
+
+    groups: List[int] = []  # numel per bucket
+    if bucket_size is None:
+        groups = [total]
+    elif bucket_size == 0:
+        groups = list(sizes)
+    else:
+        cur = 0
+        for s in sizes:
+            cur += s
+            if cur >= bucket_size:
+                groups.append(cur)
+                cur = 0
+        if cur:
+            groups.append(cur)
+
+    buckets = []
+    off = 0
+    for g in groups:
+        buckets.append(Bucket(off, g, max(min_k, k_for(g, density))))
+        off += g
+    assert off == total
+    return BucketPlan(tuple(buckets), total)
+
+
+def plan_for_params(params: Any, density: float,
+                    bucket_size: Optional[int] = None) -> BucketPlan:
+    return make_bucket_plan(leaf_sizes(params), density, bucket_size)

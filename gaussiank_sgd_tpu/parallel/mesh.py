@@ -26,20 +26,43 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+# Env vars whose presence means "this process was launched as part of a
+# multi-process job" — if any is set, a failed jax.distributed.initialize()
+# is a hard error: swallowing it would let each host silently train its own
+# unsynchronized replica.
+_MULTIHOST_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+    "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "TPU_WORKER_HOSTNAMES",  # multi-host TPU slice metadata
+)
+
+
 def maybe_initialize_distributed() -> None:
     """Initialize multi-host JAX if launched as part of a multi-process job.
 
     Safe to call unconditionally: a single-process run (including the CPU test
     mesh and the single-chip bench) is a no-op. This replaces the reference's
     ``hvd.init()`` / ``MPI_Init`` (SURVEY.md §3.1 step 1).
+
+    If the environment *looks* multi-host (coordinator/process-count env vars
+    set) a failure to initialize is re-raised — a multi-host job falling back
+    to per-host independent training is the worst silent failure mode a
+    data-parallel framework has.
     """
     try:
         jax.distributed.initialize()
-    except Exception:
-        # Single-process run (no cluster autodetected / no coordinator
-        # address) or already initialized — both are fine; multi-host TPU
-        # pods autodetect the coordinator from slice metadata and succeed.
-        pass
+    except Exception as e:  # noqa: BLE001 — classified below
+        if any(os.environ.get(v) for v in _MULTIHOST_ENV_VARS):
+            raise RuntimeError(
+                "multi-host launch detected (coordinator env vars set) but "
+                "jax.distributed.initialize() failed — refusing to continue "
+                "as an unsynchronized single-process job") from e
+        # Single-process run (no cluster autodetected) or already
+        # initialized — both fine; log for debuggability and move on.
+        import logging
+        logging.getLogger(__name__).debug(
+            "jax.distributed.initialize() skipped: %s", e)
 
 
 def data_parallel_mesh(num_devices: Optional[int] = None,
@@ -73,7 +96,20 @@ def hierarchical_dp_mesh(ici_size: int,
         raise ValueError(
             f"requested {ici_size}x{dcn_size}={want} devices, have {len(devs)}")
     devs = devs[:want]
-    arr = np.asarray(devs).reshape(dcn_size, ici_size)
+    # On real multi-slice TPU, rows of the mesh MUST be slice-contiguous or
+    # the "ici" axis collectives silently cross DCN — use the topology-aware
+    # builder, which groups by slice_index and orders within-slice devices
+    # along the ICI torus. A naive reshape is only acceptable on the virtual
+    # CPU test platform, where there is no topology at all.
+    try:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(ici_size,), dcn_mesh_shape=(dcn_size,), devices=devs)
+        arr = arr.reshape(dcn_size, ici_size)
+    except Exception:
+        if devs and devs[0].platform != "cpu":
+            raise  # never fall back to a topology-blind layout on hardware
+        arr = np.asarray(devs).reshape(dcn_size, ici_size)
     return Mesh(arr, ("dcn_dp", "ici_dp"))
 
 

@@ -1,0 +1,313 @@
+"""The fused data-parallel train step — compute + compression + collectives.
+
+Reference parity: this module replaces the reference's entire L2 layer
+(``hv_distributed_optimizer.py`` + ``distributed_optimizer.py`` +
+``allreducer.py`` — SURVEY.md §2 C2/C3/C4 and §3.1/§3.3): backward hooks,
+fusion buffers, background comm threads, queues, events, and handles. On
+TPU+XLA none of that machinery survives (SURVEY.md §7 design stance): ONE
+jit-compiled SPMD program owns forward, backward, error-feedback accumulation,
+per-bucket compression, the sparse all-gather exchange, decompress-sum, and
+the inner optimizer update; XLA schedules and overlaps compute with ICI/DCN
+collectives.
+
+The algorithmic contract implemented here is SURVEY.md §2.3 exactly:
+
+    acc      = residual + scale * g_local        (scale = lr(step) if lr is
+                                                  folded before selection,
+                                                  else 1)
+    (idx, v) = select(acc, k)  per bucket        (compressor from C1)
+    residual'= acc - sent                        (error feedback)
+    G        = scatter_sum(all_gather(idx, v)) / P
+    params  '= inner_optimizer(params, G)        (SGD/momentum/Nesterov/wd)
+
+plus the dense warm-up path ``G = psum(g_local)/P`` (SURVEY.md §2.3 "Warm-up
+dense allreduce") as a *separate jitted function*, so the hot sparse program
+carries no warm-up branching.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax, shard_map
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..compressors.base import CompressedGrad
+from ..compressors.registry import CompressorSpec
+from .bucketing import BucketPlan
+
+
+class TrainState(NamedTuple):
+    """Training state. Everything is replicated across dp EXCEPT
+    ``ef_residual``, which is genuinely per-worker (each worker's un-sent
+    gradient mass from *its own* batch shards) and therefore lives as a
+    ``[num_devices, total_numel]`` array sharded over the dp axes — so a
+    checkpoint/restore or reshard preserves every worker's residual, not
+    just worker 0's (SURVEY.md §2.3, §3.5: the reference likely drops EF
+    state from checkpoints; we keep it, correctly sharded).
+    """
+
+    step: jax.Array          # int32 scalar (replicated)
+    params: Any              # model pytree (replicated)
+    opt_state: optax.OptState  # (replicated)
+    ef_residual: jax.Array   # float32[num_devices, total_numel], sharded(dp)
+    rng: jax.Array           # PRNG key (replicated)
+
+
+class StepMetrics(NamedTuple):
+    loss: jax.Array           # mean over global batch
+    aux: Any                  # loss_fn auxiliary output (averaged over dp)
+    grad_norm: jax.Array      # dp-mean of per-worker flat-grad L2 norms
+    num_selected: jax.Array   # dp-mean of entries crossing threshold (float,
+                              # pre-truncation) — the reference's logged
+                              # selection-count observability
+    bytes_sent: jax.Array     # int32: per-worker payload of this step's exchange
+
+
+# loss_fn(params, batch, rng) -> (scalar loss, aux pytree)
+LossFn = Callable[[Any, Any, jax.Array], Tuple[jax.Array, Any]]
+
+
+def _microbatch_grads(loss_fn: LossFn, params: Any, batch: Any,
+                      rng: jax.Array, num_microbatches: int):
+    """Local grads, averaged over ``num_microbatches`` sequential microbatches.
+
+    Reference parity: ``--nsteps-update`` gradient accumulation
+    (SURVEY.md §2.2). The local batch's leading dim is split into
+    ``num_microbatches`` equal chunks and scanned — constant memory in the
+    accumulation factor.
+    """
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    if num_microbatches <= 1:
+        (loss, aux), grads = grad_fn(params, batch, rng)
+        return loss, aux, grads
+
+    def split(x):
+        return x.reshape((num_microbatches, x.shape[0] // num_microbatches)
+                         + x.shape[1:])
+
+    mb = jax.tree.map(split, batch)
+    rngs = jax.random.split(rng, num_microbatches)
+
+    def body(carry, mb_rng):
+        mb_i, rng_i = mb_rng
+        (loss, aux), grads = grad_fn(params, mb_i, rng_i)
+        c_loss, c_aux, c_grads = carry
+        return ((c_loss + loss, jax.tree.map(jnp.add, c_aux, aux),
+                 jax.tree.map(jnp.add, c_grads, grads)), None)
+
+    (loss0, aux0), grads0 = grad_fn(params, jax.tree.map(lambda x: x[0], mb),
+                                    rngs[0])
+    (loss, aux, grads), _ = lax.scan(
+        body, (loss0, aux0, grads0),
+        (jax.tree.map(lambda x: x[1:], mb), rngs[1:]))
+    inv = 1.0 / num_microbatches
+    return (loss * inv, jax.tree.map(lambda x: x * inv, aux),
+            jax.tree.map(lambda x: x * inv, grads))
+
+
+def _clip_by_global_norm(flat_g: jax.Array, clip: Optional[float]):
+    """Pre-compression grad clipping (the reference's LSTM clip, SURVEY §3.2)."""
+    if clip is None:
+        return flat_g
+    norm = jnp.linalg.norm(flat_g)
+    scale = jnp.minimum(1.0, clip / (norm + 1e-12))
+    return flat_g * scale
+
+
+def compress_buckets(spec: CompressorSpec, plan: BucketPlan, acc: jax.Array,
+                     rng: jax.Array):
+    """Run the compressor over every bucket; concat packed pairs globally.
+
+    Bucket-local indices are offset into the global flat space so the whole
+    model exchanges as ONE (idx, val) pair of arrays — one collective per
+    step no matter how many buckets (SURVEY.md §7 design stance). Returns
+    (CompressedGrad over global flat indices, residual, num_selected).
+    """
+    idx_parts, val_parts, res_parts, nsel = [], [], [], jnp.int32(0)
+    for i, b in enumerate(plan.buckets):
+        chunk = lax.dynamic_slice_in_dim(acc, b.offset, b.size)
+        r = (spec.fn(chunk, b.k, jax.random.fold_in(rng, i))
+             if spec.requires_rng else spec.fn(chunk, b.k))
+        idx_parts.append(r.compressed.indices + b.offset)
+        val_parts.append(r.compressed.values)
+        res_parts.append(r.residual)
+        nsel = nsel + r.num_selected
+    comp = CompressedGrad(jnp.concatenate(idx_parts),
+                          jnp.concatenate(val_parts))
+    return comp, jnp.concatenate(res_parts), nsel
+
+
+class DPTrainStep(NamedTuple):
+    """The compiled-step bundle the trainer drives.
+
+    ``sparse_step`` / ``dense_step`` are jitted ``(state, batch) ->
+    (state, StepMetrics)`` over the mesh; the trainer picks dense during
+    warm-up (SURVEY.md §2.3) in plain Python — no traced epoch branching
+    (SURVEY.md §7 stage 3).
+    """
+
+    sparse_step: Callable[[TrainState, Any], Tuple[TrainState, StepMetrics]]
+    dense_step: Callable[[TrainState, Any], Tuple[TrainState, StepMetrics]]
+    init_state: Callable[[Any, jax.Array], TrainState]
+    plan: BucketPlan
+    mesh: Mesh
+
+
+def build_dp_train_step(
+    loss_fn: LossFn,
+    optimizer: optax.GradientTransformation,
+    spec: CompressorSpec,
+    plan: BucketPlan,
+    mesh: Mesh,
+    *,
+    num_microbatches: int = 1,
+    clip_norm: Optional[float] = None,
+    fold_lr: Optional[Callable[[jax.Array], jax.Array]] = None,
+    grad_dtype=jnp.float32,
+) -> DPTrainStep:
+    """Build the data-parallel train step over ``mesh``.
+
+    ``fold_lr``: optional schedule ``step -> lr``. When given, the EF
+    accumulator carries lr-scaled gradients (``acc = residual + lr*g``) and
+    ``optimizer`` must be built with unit learning rate — this is the
+    reference's fold-lr-before-selection variant (SURVEY.md §2.3 note). When
+    None (default), EF runs on raw gradients and ``optimizer`` owns the lr —
+    equivalent up to schedule, and friendlier to arbitrary optax chains.
+
+    The mesh may be 1-D ``('dp',)`` or hierarchical ``('dcn_dp','ici_dp')``;
+    with a hierarchical mesh the sparse all-gather stays on the (fast) last
+    axis and only an already-dense partial crosses the first axis
+    (SURVEY.md §7 hard part 3).
+    """
+    axes = tuple(mesh.axis_names)
+    gather_axis = axes[-1]          # ICI axis on hierarchical meshes
+    outer_axes = axes[:-1]          # DCN axes (empty on 1-D meshes)
+    n_total = plan.total_numel
+
+    def _all_axes_size():
+        p = 1
+        for a in axes:
+            p *= lax.psum(1, a)
+        return p
+
+    def _pmean(x):
+        for a in axes:
+            x = lax.pmean(x, a)
+        return x
+
+    def _linear_device_index():
+        idx = jnp.int32(0)
+        for a in axes:
+            idx = idx * lax.axis_size(a) + lax.axis_index(a)
+        return idx
+
+    def _step_rngs(state: TrainState):
+        """Two decorrelated streams from the state key (domain-separated).
+
+        * data rng — additionally folded with the worker index, so dropout
+          masks differ across dp shards (each shard sees different data);
+        * compressor rng — identical on every shard, so randomk/dgc index
+          draws align across workers, the SPMD analogue of the reference's
+          shared compressor seed (SURVEY.md §2.3 RandomK).
+        """
+        base = jax.random.fold_in(state.rng, state.step)
+        data_rng = jax.random.fold_in(jax.random.fold_in(base, 0),
+                                      _linear_device_index())
+        comp_rng = jax.random.fold_in(base, 1)
+        return data_rng, comp_rng
+
+    def _local_grads(state: TrainState, batch: Any, data_rng: jax.Array):
+        loss, aux, grads = _microbatch_grads(
+            loss_fn, state.params, batch, data_rng, num_microbatches)
+        flat_g, unravel = ravel_pytree(grads)
+        flat_g = _clip_by_global_norm(flat_g.astype(grad_dtype), clip_norm)
+        # dp-mean of loss/aux for logging (grads are exchanged separately)
+        return _pmean(loss), jax.tree.map(_pmean, aux), flat_g, unravel
+
+    def _apply(state: TrainState, dense_flat: jax.Array, unravel,
+               new_residual: jax.Array):
+        updates, opt_state = optimizer.update(
+            unravel(dense_flat), state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(state.step + 1, params, opt_state, new_residual,
+                          state.rng)
+
+    def sparse_step_fn(state: TrainState, batch: Any):
+        data_rng, comp_rng = _step_rngs(state)
+        loss, aux, flat_g, unravel = _local_grads(state, batch, data_rng)
+        scale = fold_lr(state.step) if fold_lr is not None else 1.0
+        acc = state.ef_residual[0] + scale * flat_g  # local residual row
+        comp, residual, nsel = compress_buckets(spec, plan, acc, comp_rng)
+
+        # ONE all-gather of the packed pairs over the (ICI) gather axis,
+        # scatter-summed dense; hierarchical meshes psum the dense partial
+        # across the outer (DCN) axes (collectives.py documents the math).
+        g_idx = lax.all_gather(comp.indices, gather_axis, tiled=True)
+        g_val = lax.all_gather(comp.values, gather_axis, tiled=True)
+        dense = jnp.zeros((n_total,), grad_dtype).at[g_idx].add(
+            g_val.astype(grad_dtype))
+        for a in outer_axes:
+            dense = lax.psum(dense, a)
+        dense = dense / _all_axes_size()
+
+        new_state = _apply(state, dense, unravel, residual[None, :])
+        k_packed = comp.indices.shape[0]
+        bytes_sent = jnp.int32(k_packed * (4 + comp.values.dtype.itemsize))
+        return new_state, StepMetrics(
+            loss, aux, _pmean(jnp.linalg.norm(flat_g)),
+            _pmean(nsel.astype(jnp.float32)), bytes_sent)
+
+    def dense_step_fn(state: TrainState, batch: Any):
+        data_rng, _ = _step_rngs(state)
+        loss, aux, flat_g, unravel = _local_grads(state, batch, data_rng)
+        scale = fold_lr(state.step) if fold_lr is not None else 1.0
+        dense = scale * flat_g
+        for a in axes:
+            dense = lax.psum(dense, a)
+        dense = dense / _all_axes_size()
+        # Warm-up is compression-off: the EF residual is untouched (and zero
+        # if warm-up precedes any sparse step), matching SURVEY.md §2.3.
+        new_state = _apply(state, dense, unravel, state.ef_residual)
+        return new_state, StepMetrics(
+            loss, aux, _pmean(jnp.linalg.norm(flat_g)),
+            jnp.float32(n_total), jnp.int32(n_total * 4))
+
+    batch_spec = P(axes)            # leading dim sharded over every dp axis
+    # Pytree-prefix specs: everything in TrainState is replicated except the
+    # per-worker ef_residual, which shards its leading [num_devices] dim.
+    state_spec = TrainState(step=P(), params=P(), opt_state=P(),
+                            ef_residual=P(axes), rng=P())
+
+    def _wrap(fn):
+        smapped = shard_map(
+            fn, mesh=mesh,
+            in_specs=(state_spec, batch_spec),
+            out_specs=(state_spec, P()),
+            check_vma=False,
+        )
+        return jax.jit(smapped, donate_argnums=(0,))
+
+    def init_state(params: Any, rng: jax.Array) -> TrainState:
+        flat, _ = ravel_pytree(params)
+        assert flat.size == n_total, (
+            f"bucket plan built for {n_total} params, model has {flat.size}")
+        # The step functions donate their input state; copy so the caller's
+        # param buffers are never invalidated (and two states can share an
+        # init pytree).
+        params = jax.tree.map(jnp.copy, params)
+        return TrainState(
+            step=jnp.int32(0),
+            params=params,
+            opt_state=optimizer.init(params),
+            ef_residual=jnp.zeros((mesh.size, n_total), grad_dtype),
+            rng=rng,
+        )
+
+    return DPTrainStep(_wrap(sparse_step_fn), _wrap(dense_step_fn),
+                       init_state, plan, mesh)

@@ -16,6 +16,11 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+# chex (via optax/flax) imports jax.experimental.checkify, whose import-time
+# MLIR registrations require the 'tpu' platform to still be known — import it
+# before the factories are dropped below.
+import chex  # noqa: E402, F401
+import optax  # noqa: E402, F401
 import jax._src.xla_bridge as _xb  # noqa: E402
 
 # The environment's sitecustomize registers an 'axon' backend factory that

@@ -16,14 +16,13 @@ from gaussiank_sgd_tpu.compressors import (CompressResult, decompress,
                                            get_compressor, k_for, NAMES,
                                            pack_by_threshold)
 
-RNG = np.random.default_rng(0)
-
-
-def _acc(n=4096, scale=1.0, dist="normal"):
+def _acc(n=4096, scale=1.0, dist="normal", seed=0):
+    # fresh generator per call: test data must not depend on execution order
+    rng = np.random.default_rng(seed)
     if dist == "normal":
-        a = RNG.normal(0.0, scale, size=n)
+        a = rng.normal(0.0, scale, size=n)
     elif dist == "laplace":  # heavy-tailed, the PTB-LSTM regime (BASELINE cfg 4)
-        a = RNG.laplace(0.0, scale, size=n)
+        a = rng.laplace(0.0, scale, size=n)
     else:
         raise ValueError(dist)
     return jnp.asarray(a, jnp.float32)
@@ -135,7 +134,7 @@ def test_randomk_aligned_across_identical_keys():
     """Same PRNG key -> same index set: the SPMD alignment the reference gets
     from shared seeds (SURVEY.md §2.3 RandomK)."""
     spec = get_compressor("randomk")
-    acc1, acc2 = _acc(512), _acc(512)
+    acc1, acc2 = _acc(512, seed=1), _acc(512, seed=2)
     r1 = spec.fn(acc1, 16, jax.random.PRNGKey(7))
     r2 = spec.fn(acc2, 16, jax.random.PRNGKey(7))
     np.testing.assert_array_equal(r1.compressed.indices, r2.compressed.indices)

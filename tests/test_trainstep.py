@@ -1,0 +1,229 @@
+"""Tests for the fused DP train step (SURVEY.md §4 implication (b)).
+
+All run on the virtual 8-device CPU mesh from conftest.py — the multi-worker
+testing the reference could never do without a cluster (SURVEY.md §4 item 4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.flatten_util import ravel_pytree
+
+from gaussiank_sgd_tpu.compressors import get_compressor
+from gaussiank_sgd_tpu.parallel.bucketing import (make_bucket_plan,
+                                                  plan_for_params)
+from gaussiank_sgd_tpu.parallel.mesh import (data_parallel_mesh,
+                                             hierarchical_dp_mesh,
+                                             shard_batch)
+from gaussiank_sgd_tpu.parallel.trainstep import build_dp_train_step
+
+
+def make_problem(din=16, dout=4, width=32, seed=0):
+    """A 2-layer MLP regression problem, deterministic."""
+    k = jax.random.PRNGKey(seed)
+    k1, k2, kx, kw = jax.random.split(k, 4)
+    params = {
+        "w1": jax.random.normal(k1, (din, width)) * 0.1,
+        "b1": jnp.zeros((width,)),
+        "w2": jax.random.normal(k2, (width, dout)) * 0.1,
+        "b2": jnp.zeros((dout,)),
+    }
+    w_true = jax.random.normal(kw, (din, dout))
+
+    def loss_fn(p, batch, rng):
+        x, y = batch
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        pred = h @ p["w2"] + p["b2"]
+        return jnp.mean((pred - y) ** 2), {"mse": jnp.mean((pred - y) ** 2)}
+
+    def make_batch(n, seed=1):
+        kx2 = jax.random.PRNGKey(seed)
+        x = jax.random.normal(kx2, (n, din))
+        return (x, x @ w_true)
+
+    return params, loss_fn, make_batch
+
+
+def build(compressor="topk", density=0.25, bucket_size=None, mesh=None,
+          lr=0.05, momentum=0.9, **kw):
+    params, loss_fn, make_batch = make_problem()
+    mesh = mesh or data_parallel_mesh()
+    spec = get_compressor(compressor, density=density)
+    plan = plan_for_params(params, density, bucket_size)
+    opt = optax.sgd(lr, momentum=momentum)
+    ts = build_dp_train_step(loss_fn, opt, spec, plan, mesh, **kw)
+    state = ts.init_state(params, jax.random.PRNGKey(42))
+    return ts, state, make_batch, mesh
+
+
+def test_dense_step_runs_and_loss_decreases():
+    ts, state, make_batch, mesh = build("topk")
+    batch = shard_batch(mesh, make_batch(64))
+    losses = []
+    for _ in range(20):
+        state, m = ts.dense_step(state, batch)
+        losses.append(float(m.loss))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_sparse_full_density_matches_dense():
+    """density=1.0 topk sparse path == dense psum path (SURVEY §4 (b))."""
+    params, loss_fn, make_batch = make_problem()
+    mesh = data_parallel_mesh()
+    opt = optax.sgd(0.05, momentum=0.9)
+    spec = get_compressor("topk", density=1.0)
+    plan = plan_for_params(params, 1.0)
+    ts = build_dp_train_step(loss_fn, opt, spec, plan, mesh)
+    batch = shard_batch(mesh, make_batch(64))
+
+    s_dense = ts.init_state(params, jax.random.PRNGKey(0))
+    s_sparse = ts.init_state(params, jax.random.PRNGKey(0))
+    for _ in range(5):
+        s_dense, _ = ts.dense_step(s_dense, batch)
+        s_sparse, _ = ts.sparse_step(s_sparse, batch)
+    fd, _ = ravel_pytree(s_dense.params)
+    fs, _ = ravel_pytree(s_sparse.params)
+    np.testing.assert_allclose(np.asarray(fd), np.asarray(fs),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("compressor", ["topk", "gaussian", "randomkec",
+                                        "dgcsampling", "redsync"])
+def test_sparse_step_converges(compressor):
+    """EF-sparsified training at 10% density still optimizes (SURVEY §2.3).
+
+    momentum=0: randomk's sparse stochastic updates diverge under heavy
+    momentum on this tiny problem; plain EF-SGD is the paper setting.
+    """
+    ts, state, make_batch, mesh = build(compressor, density=0.10,
+                                        momentum=0.0)
+    batch = shard_batch(mesh, make_batch(64))
+    losses = []
+    for _ in range(60):
+        state, m = ts.sparse_step(state, batch)
+        losses.append(float(m.loss))
+    assert losses[-1] < losses[0] * 0.5, losses[-1]
+
+
+def test_warmup_then_sparse_transition():
+    ts, state, make_batch, mesh = build("gaussian", density=0.05)
+    batch = shard_batch(mesh, make_batch(64))
+    for i in range(5):
+        state, m = ts.dense_step(state, batch)
+    assert int(state.step) == 5
+    assert float(jnp.abs(state.ef_residual).sum()) == 0.0  # untouched in warmup
+    for i in range(10):
+        state, m = ts.sparse_step(state, batch)
+    assert int(state.step) == 15
+    assert float(jnp.abs(state.ef_residual).sum()) > 0.0   # EF now carrying
+
+
+def test_ef_residual_carries_unsent_mass():
+    """After one sparse step: residual + sent == acc (elementwise split)."""
+    ts, state, make_batch, mesh = build("topk", density=0.1, momentum=0.0,
+                                        lr=1.0)
+    batch = shard_batch(mesh, make_batch(8))
+    # With P workers seeing identical per-shard batches? They don't — batch is
+    # sharded. Instead verify conservation: acc == residual' + contribution,
+    # using the public pieces directly on one shard's grad.
+    import gaussiank_sgd_tpu.compressors as C
+    g = jax.random.normal(jax.random.PRNGKey(3), (1000,))
+    res0 = jax.random.normal(jax.random.PRNGKey(4), (1000,)) * 0.01
+    acc = res0 + g
+    out = C.topk_compress(acc, 100)
+    sent = C.decompress(out.compressed, 1000)
+    np.testing.assert_allclose(np.asarray(sent + out.residual),
+                               np.asarray(acc), rtol=1e-6)
+
+
+def test_bucketed_matches_semantics_and_converges():
+    ts, state, make_batch, mesh = build("gaussian", density=0.1,
+                                        bucket_size=256)
+    assert len(ts.plan.buckets) > 1
+    batch = shard_batch(mesh, make_batch(64))
+    losses = []
+    for _ in range(40):
+        state, m = ts.sparse_step(state, batch)
+        losses.append(float(m.loss))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_per_tensor_buckets():
+    plan = make_bucket_plan([100, 5, 200], 0.1, bucket_size=0)
+    assert [b.size for b in plan.buckets] == [100, 5, 200]
+    assert [b.k for b in plan.buckets] == [10, 1, 20]
+    plan2 = make_bucket_plan([100, 5, 200], 0.1, bucket_size=150)
+    assert [b.size for b in plan2.buckets] == [305] or \
+           [b.size for b in plan2.buckets] == [205, 100]  # greedy merge
+    plan3 = make_bucket_plan([100, 5, 200], 0.1, bucket_size=None)
+    assert [b.size for b in plan3.buckets] == [305]
+
+
+def test_hierarchical_mesh_sparse_step():
+    """2x4 (dcn, ici) mesh: sparse gather on ici, dense psum over dcn."""
+    mesh = hierarchical_dp_mesh(ici_size=4, dcn_size=2)
+    ts, state, make_batch, _ = build("gaussian", density=0.1, mesh=mesh)
+    batch = shard_batch(mesh, make_batch(64))
+    losses = []
+    for _ in range(40):
+        state, m = ts.sparse_step(state, batch)
+        losses.append(float(m.loss))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_microbatch_accumulation_matches_big_batch():
+    """nsteps_update=4 over the same data == single big batch (dense path)."""
+    params, loss_fn, make_batch = make_problem()
+    mesh = data_parallel_mesh()
+    opt = optax.sgd(0.05)
+    spec = get_compressor("topk", density=1.0)
+    plan = plan_for_params(params, 1.0)
+    ts1 = build_dp_train_step(loss_fn, opt, spec, plan, mesh,
+                              num_microbatches=1)
+    ts4 = build_dp_train_step(loss_fn, opt, spec, plan, mesh,
+                              num_microbatches=4)
+    batch = shard_batch(mesh, make_batch(64))
+    s1 = ts1.init_state(params, jax.random.PRNGKey(0))
+    s4 = ts4.init_state(params, jax.random.PRNGKey(0))
+    s1, m1 = ts1.dense_step(s1, batch)
+    s4, m4 = ts4.dense_step(s4, batch)
+    f1, _ = ravel_pytree(s1.params)
+    f4, _ = ravel_pytree(s4.params)
+    np.testing.assert_allclose(np.asarray(f1), np.asarray(f4),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_fold_lr_variant():
+    """fold_lr: EF carries lr-scaled grads, inner opt has unit lr."""
+    params, loss_fn, make_batch = make_problem()
+    mesh = data_parallel_mesh()
+    sched = lambda step: 0.05
+    spec = get_compressor("gaussian", density=0.1)
+    plan = plan_for_params(params, 0.1)
+    ts = build_dp_train_step(loss_fn, optax.sgd(1.0, momentum=0.9), spec,
+                             plan, mesh, fold_lr=sched)
+    state = ts.init_state(params, jax.random.PRNGKey(0))
+    batch = shard_batch(mesh, make_batch(64))
+    losses = []
+    for _ in range(60):
+        state, m = ts.sparse_step(state, batch)
+        losses.append(float(m.loss))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_grad_clipping():
+    ts, state, make_batch, mesh = build("topk", density=0.5, clip_norm=0.01)
+    batch = shard_batch(mesh, make_batch(64))
+    state, m = ts.dense_step(state, batch)
+    assert float(m.grad_norm) <= 0.0101
+
+
+def test_metrics_fields():
+    ts, state, make_batch, mesh = build("gaussian", density=0.1)
+    batch = shard_batch(mesh, make_batch(64))
+    state, m = ts.sparse_step(state, batch)
+    assert m.bytes_sent.dtype == jnp.int32
+    assert int(m.bytes_sent) == ts.plan.total_k * 8
+    assert int(m.num_selected) >= 0
