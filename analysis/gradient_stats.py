@@ -1,0 +1,109 @@
+"""Gradient-distribution statistics — the paper's Gaussianity evidence.
+
+Reference parity: the gradient-histogram/normality scripts of SURVEY.md §2
+C13 (used to justify the Gaussian threshold model, arXiv:1911.08772 §3) and
+§4's "compressor micro-experiment" sanity checks. Trains a model for a few
+steps on the CPU mesh, collects per-step EF-accumulated gradients, and
+reports moments / normality measures + how well the Gaussian tail estimate
+predicts the top-k threshold — runnable offline, no plotting required.
+
+Usage:
+  python analysis/gradient_stats.py [--dnn mnistnet --dataset mnist]
+      [--steps 20] [--density 0.001]
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+
+import numpy as np
+
+
+def normality_report(g: np.ndarray, density: float):
+    n = g.size
+    mu, sigma = float(g.mean()), float(g.std())
+    skew = float(((g - mu) ** 3).mean() / (sigma ** 3 + 1e-30))
+    kurt = float(((g - mu) ** 4).mean() / (sigma ** 4 + 1e-30)) - 3.0
+    k = max(1, int(math.ceil(density * n)))
+    kth = float(np.sort(np.abs(g))[-k])
+    # the GaussianK model's predicted threshold for this density
+    from scipy.special import ndtri
+    s = float(ndtri(1.0 - min(max(density, 1e-12), 0.5) / 2.0))
+    pred = abs(mu) + s * sigma
+    sel = int((np.abs(g) > pred).sum())
+    return {
+        "n": n, "mu": mu, "sigma": sigma, "skew": skew,
+        "excess_kurtosis": kurt,
+        "true_kth_magnitude": kth, "gaussian_pred_threshold": pred,
+        "pred_over_true": pred / (kth + 1e-30),
+        "selected_at_pred": sel, "target_k": k,
+        "count_ratio": sel / k,
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--dnn", default="mnistnet")
+    p.add_argument("--dataset", default="mnist")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--density", type=float, default=0.001)
+    args = p.parse_args(argv)
+
+    # CPU-mesh platform setup (same recipe as tests/conftest.py)
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import chex, optax  # noqa: F401  (platform registration order)
+    import jax.experimental.pallas  # noqa: F401
+    import jax._src.xla_bridge as xb
+    for plat in ("axon", "tpu"):
+        xb._backend_factories.pop(plat, None)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+    from gaussiank_sgd_tpu import data as data_lib, models as models_lib
+    from gaussiank_sgd_tpu.training.losses import make_loss_fn
+
+    spec = models_lib.get_model(args.dnn, args.dataset)
+    ds, _ = data_lib.make_dataset(args.dataset, None, True, batch_size=64)
+    rng = jax.random.PRNGKey(0)
+    dummy = jnp.zeros((2,) + spec.input_shape, spec.input_dtype)
+    variables = spec.module.init({"params": rng, "dropout": rng}, dummy,
+                                 train=False)
+    params = variables["params"]
+    mstate = {k: v for k, v in variables.items() if k != "params"}
+    loss_fn = make_loss_fn(spec)
+    grad_fn = jax.jit(jax.grad(
+        lambda p, m, b, r: loss_fn(p, m, b, r)[0]))
+
+    import optax as _optax
+    opt = _optax.sgd(0.05, momentum=0.9)
+    opt_state = opt.init(params)
+    ef = None
+    it = iter(ds)
+    for step in range(args.steps):
+        x, y = next(it)
+        g = grad_fn(params, mstate, (jnp.asarray(x), jnp.asarray(y)),
+                    jax.random.fold_in(rng, step))
+        flat, unravel = ravel_pytree(g)
+        ef = flat if ef is None else ef + flat
+        updates, opt_state = opt.update(g, opt_state, params)
+        params = _optax.apply_updates(params, updates)
+        if step in (0, args.steps // 2, args.steps - 1):
+            rep = normality_report(np.asarray(flat), args.density)
+            print(f"step {step:3d} raw-grad: " + " ".join(
+                f"{k}={v:.4g}" for k, v in rep.items()))
+    rep = normality_report(np.asarray(ef), args.density)
+    print("accumulated (EF-like) gradient:")
+    print("  " + " ".join(f"{k}={v:.4g}" for k, v in rep.items()))
+    ok = 0.2 < rep["count_ratio"] < 5.0
+    print(f"Gaussian tail estimate within 5x of target k: {ok}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Parse metrics.jsonl runs and plot/compare convergence.
+
+Reference parity: the log-parsing plot scripts of SURVEY.md §2 C13 (the
+reference greps its text logs; here metrics are structured JSONL so parsing
+is trivial). Produces loss / top-1 / throughput curves per run and a
+side-by-side compressor comparison. Matplotlib is optional — without it the
+script prints aligned-text summaries, which is all the offline CI box needs.
+
+Usage:
+  python analysis/plot_convergence.py runs/run1/metrics.jsonl [more.jsonl...]
+      [--out plots/] [--metric loss|acc|top1|perplexity]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from collections import defaultdict
+
+
+def load_run(path):
+    recs = [json.loads(l) for l in open(path) if l.strip()]
+    cfg = next((r for r in recs if r.get("event") == "config"), {})
+    train = [r for r in recs if r.get("event") == "train"]
+    evals = [r for r in recs if r.get("event") == "eval"]
+    name = (f"{cfg.get('dnn', '?')}/{cfg.get('compressor', '?')}"
+            f"@{cfg.get('density', '?')}")
+    return name, cfg, train, evals
+
+
+def summarize(name, cfg, train, evals):
+    if not train:
+        print(f"{name}: no train records")
+        return
+    first, last = train[0], train[-1]
+    tput = [r for r in train if r.get("step_s", 0) > 0]
+    mean_step = (sum(r["step_s"] for r in tput) / len(tput)) if tput else 0
+    print(f"== {name}")
+    print(f"   steps {first['step']}..{last['step']}  "
+          f"loss {first['loss']:.4f} -> {last['loss']:.4f}")
+    if mean_step:
+        print(f"   mean step {1e3 * mean_step:.1f} ms; "
+              f"bytes/step {last.get('bytes_sent', 0)}")
+    for e in evals[-3:]:
+        extras = {k: v for k, v in e.items()
+                  if k in ("top1", "top5", "perplexity", "val_loss")}
+        print(f"   eval@{e['step']}: " + " ".join(
+            f"{k}={v:.4f}" for k, v in extras.items()))
+
+
+def maybe_plot(runs, metric, out_dir):
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception:
+        print("(matplotlib unavailable — text summary only)")
+        return
+    os.makedirs(out_dir, exist_ok=True)
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for name, cfg, train, evals in runs:
+        if metric == "loss":
+            xs = [r["step"] for r in train]
+            ys = [r["loss"] for r in train]
+        else:
+            xs = [r["step"] for r in evals if metric in r]
+            ys = [r[metric] for r in evals if metric in r]
+        if xs:
+            ax.plot(xs, ys, label=name, linewidth=1.5)
+    ax.set_xlabel("step")
+    ax.set_ylabel(metric)
+    ax.legend(fontsize=8)
+    ax.grid(alpha=0.3)
+    path = os.path.join(out_dir, f"{metric}.png")
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    print(f"wrote {path}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("jsonl", nargs="+")
+    p.add_argument("--out", default="plots")
+    p.add_argument("--metric", default="loss")
+    args = p.parse_args(argv)
+    runs = [load_run(f) for f in args.jsonl]
+    for r in runs:
+        summarize(*r)
+    maybe_plot(runs, args.metric, args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline metric (BASELINE north-star, SURVEY.md §6): sparse-step throughput
+as a fraction of dense-step throughput on the same model/batch. Target is
+>= 0.90 ("sparse must not lose to dense"); on a single chip this measures
+the full compression pipeline overhead (EF accumulate + GaussianK threshold
+select + pack + scatter-decompress) against the plain dense step, with the
+collective degenerating over a 1-device mesh. vs_baseline = value / 0.90.
+
+Model: ResNet-20 / CIFAR-10 shapes (BASELINE config 1's model), bf16
+compute, batch 256, GaussianK at density 0.1%.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def _median_step_time(fn, state, batch, iters=20, warmup=3):
+    for _ in range(warmup):
+        state, m = fn(state, batch)
+    jax.block_until_ready(m)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        state, m = fn(state, batch)
+        jax.block_until_ready(m)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times)), state
+
+
+def main():
+    from gaussiank_sgd_tpu.compressors import get_compressor
+    from gaussiank_sgd_tpu.models import get_model
+    from gaussiank_sgd_tpu.parallel.bucketing import plan_for_params
+    from gaussiank_sgd_tpu.parallel.mesh import (data_parallel_mesh,
+                                                 shard_batch)
+    from gaussiank_sgd_tpu.parallel.trainstep import build_dp_train_step
+    from gaussiank_sgd_tpu.training.losses import make_loss_fn
+
+    batch_size = 256
+    density = 0.001
+
+    mesh = data_parallel_mesh()
+    spec = get_model("resnet20", "cifar10", dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (batch_size, 32, 32, 3), jnp.float32)
+    y = jax.random.randint(jax.random.PRNGKey(1), (batch_size,), 0, 10)
+    variables = spec.module.init({"params": rng}, x[:2], train=False)
+    params = variables["params"]
+    mstate = {k: v for k, v in variables.items() if k != "params"}
+
+    plan = plan_for_params(params, density)
+    comp = get_compressor("gaussian", density=density)
+    ts = build_dp_train_step(make_loss_fn(spec),
+                             optax.sgd(0.1, momentum=0.9), comp, plan, mesh)
+    batch = shard_batch(mesh, (x, y))
+
+    state = ts.init_state(params, jax.random.PRNGKey(2), model_state=mstate)
+    t_dense, state = _median_step_time(ts.dense_step, state, batch)
+    state = ts.init_state(params, jax.random.PRNGKey(2), model_state=mstate)
+    t_sparse, state = _median_step_time(ts.sparse_step, state, batch)
+
+    ratio = t_dense / t_sparse  # >1: sparse FASTER than dense
+    result = {
+        "metric": "sparse_vs_dense_step_throughput_ratio",
+        "value": round(ratio, 4),
+        "unit": "ratio",
+        "vs_baseline": round(ratio / 0.90, 4),
+        "detail": {
+            "model": "resnet20", "batch": batch_size, "density": density,
+            "dense_step_ms": round(1e3 * t_dense, 3),
+            "sparse_step_ms": round(1e3 * t_sparse, 3),
+            "sparse_images_per_s": round(batch_size / t_sparse, 1),
+            "platform": jax.devices()[0].platform,
+            "n_devices": mesh.size,
+        },
+    }
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

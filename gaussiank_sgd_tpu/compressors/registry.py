@@ -52,6 +52,13 @@ def get_compressor(name: str, *, density: float = 0.001,
         fn = functools.partial(gaussiank_compress, density=density,
                                sigma_scale=sigma_scale)
         return CompressorSpec("gaussian", fn, False, True, lambda k: k)
+    if name in ("gaussian_pallas", "gaussianp"):
+        # same selection contract as 'gaussian', threshold found by the
+        # 3-pass Pallas kernel estimator (ops/pallas_select.py, SURVEY §7
+        # stage 6) instead of the ~13-pass XLA mean/std+bisection composite
+        from ..ops.pallas_select import pallas_gaussian_compress
+        return CompressorSpec("gaussian_pallas", pallas_gaussian_compress,
+                              False, True, lambda k: k)
     if name == "randomk":
         return CompressorSpec("randomk", randomk_compress, True, False,
                               lambda k: k)
@@ -70,5 +77,5 @@ def get_compressor(name: str, *, density: float = 0.001,
     raise ValueError(f"unknown compressor {name!r}; known: {sorted(NAMES)}")
 
 
-NAMES = ("none", "topk", "gaussian", "randomk", "randomkec", "dgcsampling",
-         "redsync", "redsynctrim")
+NAMES = ("none", "topk", "gaussian", "gaussian_pallas", "randomk",
+         "randomkec", "dgcsampling", "redsync", "redsynctrim")

@@ -1,0 +1,89 @@
+"""Data pipelines (reference parity: dataset/dataloader construction in
+``dl_trainer.py``, SURVEY.md §2 C5; plus AN4/WMT stand-ins for C9 and
+BASELINE config 5).
+
+``make_dataset(dataset, dnn, ...)`` dispatches by the reference's
+``--dataset`` names: cifar10, cifar100, mnist, imagenet, ptb, an4, wmt14.
+Real files are used when ``data_dir`` holds them; otherwise learnable
+synthetic stand-ins (synthetic.py) keep everything runnable offline.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .cifar import make_cifar, make_mnist
+from .loader import ArrayDataset, prefetch
+from .ptb import PTBDataset, make_ptb
+from .synthetic import (synthetic_images, synthetic_seq2seq,
+                        synthetic_spectrograms, synthetic_tokens)
+
+
+def make_imagenet(data_dir: Optional[str] = None, train: bool = True,
+                  batch_size: int = 256, image_size: int = 224, seed: int = 0,
+                  synthetic_examples: int = 1024) -> Tuple[ArrayDataset, int]:
+    """ImageNet: synthetic stand-in unless a preprocessed .npy pair exists.
+
+    Real-data path: ``{data_dir}/{split}_images.npy`` +
+    ``{split}_labels.npy`` (preprocessing to packed arrays is done offline;
+    full TFDS/grain integration is deliberately out of scope for this
+    offline machine — SURVEY.md §7 hard part 5).
+    """
+    split = "train" if train else "val"
+    if data_dir and data_dir != "synthetic":
+        import os
+        xi = os.path.join(data_dir, f"{split}_images.npy")
+        yi = os.path.join(data_dir, f"{split}_labels.npy")
+        if os.path.exists(xi) and os.path.exists(yi):
+            x = np.load(xi, mmap_mode="r")
+            y = np.load(yi).astype(np.int32)
+            return ArrayDataset((x, y), batch_size, shuffle=train,
+                                seed=seed), 1000
+    x, y = synthetic_images(synthetic_examples, (image_size, image_size, 3),
+                            1000, seed=0 if train else 1)
+    return ArrayDataset((x, y), batch_size, shuffle=train, seed=seed), 1000
+
+
+def make_an4(data_dir: Optional[str] = None, train: bool = True,
+             batch_size: int = 16, seed: int = 0,
+             synthetic_examples: int = 256,
+             tgt_len: int = 8) -> Tuple[ArrayDataset, int]:
+    """AN4 speech: synthetic spectrogram/label pairs offline (C9)."""
+    x, y = synthetic_spectrograms(synthetic_examples, 161, 200, 29, tgt_len,
+                                  seed=0 if train else 1)
+    return ArrayDataset((x, y), batch_size, shuffle=train, seed=seed), 29
+
+
+def make_wmt(data_dir: Optional[str] = None, train: bool = True,
+             batch_size: int = 64, src_len: int = 64, tgt_len: int = 64,
+             vocab_size: int = 32000, seed: int = 0,
+             synthetic_examples: int = 4096) -> Tuple[ArrayDataset, int]:
+    """WMT14-like seq2seq batches (BASELINE config 5); synthetic offline."""
+    src, tgt = synthetic_seq2seq(synthetic_examples, src_len, tgt_len,
+                                 vocab_size, seed=0 if train else 1)
+    return ArrayDataset((src, tgt), batch_size, shuffle=train, seed=seed), \
+        vocab_size
+
+
+def make_dataset(dataset: str, data_dir: Optional[str] = None,
+                 train: bool = True, batch_size: int = 128, **kw):
+    """Dispatch by --dataset name (SURVEY.md §2 C6 CLI). Returns
+    (dataset, cardinality) where cardinality is num_classes / vocab /
+    num_labels depending on the task."""
+    dataset = dataset.lower()
+    if dataset in ("cifar10", "cifar100"):
+        return make_cifar(dataset, data_dir, train, batch_size, **kw)
+    if dataset == "mnist":
+        return make_mnist(data_dir, train, batch_size, **kw)
+    if dataset == "imagenet":
+        return make_imagenet(data_dir, train, batch_size, **kw)
+    if dataset == "ptb":
+        return make_ptb(data_dir, "train" if train else "valid", batch_size,
+                        **kw)
+    if dataset == "an4":
+        return make_an4(data_dir, train, batch_size, **kw)
+    if dataset in ("wmt14", "wmt"):
+        return make_wmt(data_dir, train, batch_size, **kw)
+    raise ValueError(f"unknown dataset {dataset!r}")

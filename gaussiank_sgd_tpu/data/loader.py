@@ -1,0 +1,90 @@
+"""Host-side batch iteration with background prefetch.
+
+Reference parity: the torch ``DataLoader`` worker pool the reference leans on
+(SURVEY.md §3.2 "io timer ← host dataloader workers"). Here the host work is
+tiny (index shuffling, gather, augment) and the accelerator step dominates,
+so a single prefetch thread with a bounded queue keeps the device fed; the
+optional C++ pipeline (native/) slots in behind the same iterator protocol.
+
+``ArrayDataset`` serves in-memory numpy arrays — both real files (CIFAR/PTB
+fit comfortably in host RAM, as in the reference) and synthetic data.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ArrayDataset:
+    """Shuffled, optionally-augmented minibatches over in-memory arrays.
+
+    Yields tuples of numpy arrays with leading dim ``batch_size`` (drops the
+    ragged tail, as the reference's samplers do for distributed training —
+    every worker must see the same number of steps).
+    """
+
+    def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
+                 shuffle: bool = True, seed: int = 0,
+                 augment: Optional[Callable[..., tuple]] = None):
+        lens = {len(a) for a in arrays}
+        assert len(lens) == 1, f"ragged arrays: {lens}"
+        self.arrays = tuple(arrays)
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.augment = augment
+        self._rng = np.random.default_rng(seed)
+        self.num_examples = len(arrays[0])
+        self.steps_per_epoch = self.num_examples // self.batch_size
+        assert self.steps_per_epoch > 0, (
+            f"batch_size {batch_size} > dataset size {self.num_examples}")
+
+    def epoch(self, epoch_seed: Optional[int] = None) -> Iterator[tuple]:
+        order = np.arange(self.num_examples)
+        if self.shuffle:
+            rng = (np.random.default_rng(epoch_seed) if epoch_seed is not None
+                   else self._rng)
+            rng.shuffle(order)
+        for s in range(self.steps_per_epoch):
+            sel = order[s * self.batch_size:(s + 1) * self.batch_size]
+            batch = tuple(a[sel] for a in self.arrays)
+            if self.augment is not None:
+                batch = self.augment(*batch)
+            yield batch
+
+    def __iter__(self):
+        while True:  # epoch-looping stream
+            yield from self.epoch()
+
+
+def prefetch(it: Iterator, depth: int = 2) -> Iterator:
+    """Run ``it`` in a daemon thread, keeping ``depth`` batches ready.
+
+    Overlaps host batch prep with device compute — the role of the
+    reference's DataLoader workers, one thread being plenty for these
+    workloads.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _END = object()
+    _ERR = object()
+
+    def worker():
+        try:
+            for item in it:
+                q.put(item)
+            q.put(_END)
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            q.put((_ERR, e))
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            return
+        if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+            raise RuntimeError("data prefetch thread failed") from item[1]
+        yield item

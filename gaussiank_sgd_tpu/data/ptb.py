@@ -1,0 +1,78 @@
+"""PTB language-model pipeline: tokenized bptt batching.
+
+Reference parity: ``ptb_reader.py`` (SURVEY.md §2 C8) — word-level vocab from
+``ptb.train.txt``, the classic batchify (trim to B columns of contiguous
+text) and ``get_batch`` (bptt-length windows, target = input shifted by one).
+Falls back to a synthetic Markov-chain stream (data/synthetic.py) offline.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from .synthetic import synthetic_tokens
+
+
+def build_vocab(path: str) -> Dict[str, int]:
+    vocab: Dict[str, int] = {}
+    with open(path) as f:
+        for line in f:
+            for w in line.split() + ["<eos>"]:
+                if w not in vocab:
+                    vocab[w] = len(vocab)
+    return vocab
+
+
+def tokenize(path: str, vocab: Dict[str, int]) -> np.ndarray:
+    ids = []
+    with open(path) as f:
+        for line in f:
+            for w in line.split() + ["<eos>"]:
+                ids.append(vocab.get(w, 0))
+    return np.asarray(ids, np.int32)
+
+
+class PTBDataset:
+    """Contiguous-text bptt windows: yields (inputs[B,T], targets[B,T])."""
+
+    def __init__(self, tokens: np.ndarray, batch_size: int, bptt: int = 35):
+        self.batch_size = batch_size
+        self.bptt = bptt
+        nb = len(tokens) // batch_size
+        # batchify: B parallel contiguous streams (reference layout)
+        self.data = tokens[:nb * batch_size].reshape(batch_size, nb)
+        self.steps_per_epoch = (nb - 1) // bptt
+        assert self.steps_per_epoch > 0
+
+    def epoch(self, epoch_seed=None) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        # epoch_seed accepted for interface uniformity (resume realignment);
+        # PTB text is served sequentially, so order is deterministic anyway
+        for s in range(self.steps_per_epoch):
+            i = s * self.bptt
+            x = self.data[:, i:i + self.bptt]
+            y = self.data[:, i + 1:i + 1 + self.bptt]
+            yield x, y
+
+    def __iter__(self):
+        while True:
+            yield from self.epoch()
+
+
+def make_ptb(data_dir: Optional[str] = None, split: str = "train",
+             batch_size: int = 20, bptt: int = 35,
+             vocab_size: int = 10000,
+             synthetic_tokens_n: int = 200_000) -> Tuple[PTBDataset, int]:
+    """Returns (dataset, vocab_size)."""
+    if data_dir and data_dir != "synthetic":
+        train_path = os.path.join(data_dir, "ptb.train.txt")
+        path = os.path.join(data_dir, f"ptb.{split}.txt")
+        if os.path.exists(train_path) and os.path.exists(path):
+            vocab = build_vocab(train_path)
+            toks = tokenize(path, vocab)
+            return PTBDataset(toks, batch_size, bptt), len(vocab)
+    toks = synthetic_tokens(synthetic_tokens_n, vocab_size,
+                            seed=0 if split == "train" else 1)
+    return PTBDataset(toks, batch_size, bptt), vocab_size

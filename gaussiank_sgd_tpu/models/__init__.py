@@ -1,0 +1,82 @@
+"""Model zoo (reference parity: ``models/`` + torchvision imports, SURVEY.md
+§2 C7/C8; extended with the Transformer target of BASELINE config 5).
+
+``get_model(dnn, dataset)`` mirrors the reference CLI's ``--dnn`` dispatch in
+``dl_trainer.py`` (SURVEY.md §2 C5 "model-zoo dispatch"): the same names the
+reference accepts (``resnet20 ... resnet110, vgg16, alexnet, mnistnet,
+resnet50, lstm, lstman4``) resolve here, plus ``transformer``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+
+from .alexnet import AlexNet
+from .lstm import LSTMLM
+from .mnistnet import MnistNet
+from .resnet import CifarResNet, ResNet50
+from .speech import LSTMAN4
+from .transformer import Transformer
+from .vgg import VGG16
+
+
+class ModelSpec(NamedTuple):
+    name: str
+    module: Any                       # flax linen module
+    input_shape: Tuple[int, ...]      # single-example shape (no batch dim)
+    input_dtype: Any
+    num_classes: int
+    task: str                         # 'classify' | 'lm' | 'ctc' | 'seq2seq'
+
+
+_CIFAR = (32, 32, 3)
+_IMAGENET = (224, 224, 3)
+_MNIST = (28, 28, 1)
+
+
+def get_model(dnn: str, dataset: Optional[str] = None, *,
+              num_classes: Optional[int] = None,
+              dtype=jnp.float32, **kw) -> ModelSpec:
+    dnn = dnn.lower()
+    if dnn.startswith("resnet") and dnn != "resnet50":
+        depth = int(dnn[len("resnet"):])
+        nc = num_classes or (100 if dataset == "cifar100" else 10)
+        return ModelSpec(dnn, CifarResNet(depth=depth, num_classes=nc,
+                                          dtype=dtype),
+                         _CIFAR, jnp.float32, nc, "classify")
+    if dnn == "resnet50":
+        nc = num_classes or 1000
+        return ModelSpec(dnn, ResNet50(num_classes=nc, dtype=dtype),
+                         _IMAGENET, jnp.float32, nc, "classify")
+    if dnn == "vgg16":
+        nc = num_classes or 10
+        return ModelSpec(dnn, VGG16(num_classes=nc, dtype=dtype),
+                         _CIFAR, jnp.float32, nc, "classify")
+    if dnn == "alexnet":
+        nc = num_classes or 10
+        return ModelSpec(dnn, AlexNet(num_classes=nc, dtype=dtype),
+                         _CIFAR, jnp.float32, nc, "classify")
+    if dnn in ("mnistnet", "mnist"):
+        nc = num_classes or 10
+        return ModelSpec("mnistnet", MnistNet(num_classes=nc, dtype=dtype),
+                         _MNIST, jnp.float32, nc, "classify")
+    if dnn == "lstm":  # PTB language model (SURVEY.md §2 C8)
+        vocab = kw.pop("vocab_size", 10000)
+        m = LSTMLM(vocab_size=vocab, dtype=dtype, **kw)
+        return ModelSpec("lstm", m, (35,), jnp.int32, vocab, "lm")
+    if dnn == "lstman4":  # AN4 speech (SURVEY.md §2 C9)
+        labels = kw.pop("num_labels", 29)
+        m = LSTMAN4(num_labels=labels, dtype=dtype, **kw)
+        return ModelSpec("lstman4", m, (161, 200), jnp.float32, labels, "ctc")
+    if dnn == "transformer":  # BASELINE config 5 (new target, no ref model)
+        vocab = kw.pop("vocab_size", 32000)
+        m = Transformer(vocab_size=vocab, dtype=dtype, **kw)
+        return ModelSpec("transformer", m, (64,), jnp.int32, vocab, "seq2seq")
+    raise ValueError(f"unknown dnn {dnn!r}")
+
+
+NAMES = ("resnet20", "resnet32", "resnet44", "resnet56", "resnet110",
+         "resnet50", "vgg16", "alexnet", "mnistnet", "lstm", "lstman4",
+         "transformer")

@@ -1,0 +1,36 @@
+"""AlexNet (CIFAR-sized variant).
+
+Reference parity: ``models/alexnet.py`` (SURVEY.md §2 C7) — the compact
+CIFAR AlexNet used in the compression literature (not the 227x227 original).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (3, 3), strides=(2, 2), padding=1,
+                    dtype=self.dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(192, (3, 3), padding=1, dtype=self.dtype)(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(384, (3, 3), padding=1, dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), padding=1, dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), padding=1, dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

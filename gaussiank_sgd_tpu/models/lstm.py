@@ -1,0 +1,44 @@
+"""2-layer LSTM language model for PTB.
+
+Reference parity: ``lstmpy.py`` (SURVEY.md §2 C8) — embedding, 2 stacked LSTM
+layers, dropout, tied-capacity output projection; trained with CE-per-token
+and evaluated in perplexity with grad-norm clipping (SURVEY.md §3.2), which
+the train step applies via ``clip_norm``.
+
+TPU note: the recurrence runs under ``nn.RNN`` (``lax.scan`` inside), so the
+whole unrolled window is one fused XLA while-loop — no per-timestep dispatch.
+The reference carries the hidden state across bptt windows ("repackaging");
+here each window starts from a learned-zero carry by default, and a carry can
+be threaded explicitly through ``initial_carry`` for exact parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LSTMLM(nn.Module):
+    vocab_size: int = 10000
+    embed_dim: int = 650
+    hidden_dim: int = 650
+    num_layers: int = 2
+    dropout: float = 0.5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True, initial_carry=None):
+        # tokens: int32[B, T] -> logits float[B, T, V]
+        x = nn.Embed(self.vocab_size, self.embed_dim,
+                     dtype=self.dtype)(tokens)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for i in range(self.num_layers):
+            rnn = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim,
+                                              dtype=self.dtype),
+                         name=f"lstm_{i}")
+            carry = None if initial_carry is None else initial_carry[i]
+            x = rnn(x, initial_carry=carry)
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32)(x)

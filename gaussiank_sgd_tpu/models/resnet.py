@@ -1,0 +1,119 @@
+"""ResNets: CIFAR ResNet-{20,32,44,56,110} and ImageNet ResNet-50.
+
+Reference parity: ``models/resnet.py`` (CIFAR family, He et al. §4.2 layout:
+3 stages of n=(depth-2)/6 basic blocks at widths 16/32/64, option-A
+parameter-free shortcuts) and the torchvision ResNet-50 the reference uses for
+ImageNet (SURVEY.md §2 C7). TPU-first: NHWC layout (XLA:TPU's native conv
+layout), bf16-capable compute dtype with fp32 params and fp32 BatchNorm
+statistics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    """3x3-3x3 residual block with option-A (zero-pad) shortcut."""
+
+    filters: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        y = conv(self.filters, (3, 3), strides=(self.stride, self.stride),
+                 padding=1)(x)
+        y = nn.relu(bn()(y))
+        y = conv(self.filters, (3, 3), padding=1)(y)
+        y = bn()(y)
+        if self.stride != 1 or x.shape[-1] != self.filters:
+            # option A: spatial subsample + zero-pad channels — no params,
+            # matching the CIFAR paper/reference configuration.
+            x = x[:, ::self.stride, ::self.stride, :]
+            pad = self.filters - x.shape[-1]
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, pad)))
+        return nn.relu(y + x)
+
+
+class CifarResNet(nn.Module):
+    """depth = 6n+2: resnet20/32/44/56/110 (SURVEY.md §2 C7)."""
+
+    depth: int = 20
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        assert (self.depth - 2) % 6 == 0, f"bad CIFAR resnet depth {self.depth}"
+        n = (self.depth - 2) // 6
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 dtype=jnp.float32)(x))
+        for i, filters in enumerate((16, 32, 64)):
+            for b in range(n):
+                stride = 2 if (i > 0 and b == 0) else 1
+                x = BasicBlock(filters, stride, self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1-3x3-1x1 bottleneck with projection shortcut (ResNet-50)."""
+
+    filters: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=0.9, epsilon=1e-5, dtype=jnp.float32)
+        out = self.filters * 4
+        y = nn.relu(bn()(conv(self.filters, (1, 1))(x)))
+        y = nn.relu(bn()(conv(self.filters, (3, 3),
+                              strides=(self.stride, self.stride),
+                              padding=1)(y)))
+        # zero-init the last BN's scale: standard ResNet-50 recipe, the
+        # residual branch starts as identity (helps large-batch DP training)
+        y = bn(scale_init=nn.initializers.zeros)(conv(out, (1, 1))(y))
+        if self.stride != 1 or x.shape[-1] != out:
+            x = bn()(conv(out, (1, 1),
+                          strides=(self.stride, self.stride))(x))
+        return nn.relu(y + x)
+
+
+class ResNet50(nn.Module):
+    """ImageNet ResNet-50 (BASELINE configs 3; north-star 76.1% top-1)."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=3, use_bias=False,
+                    dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                 dtype=jnp.float32)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, (blocks, filters) in enumerate(
+                zip(self.stage_sizes, (64, 128, 256, 512))):
+            for b in range(blocks):
+                stride = 2 if (i > 0 and b == 0) else 1
+                x = BottleneckBlock(filters, stride, self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,52 @@
+"""DeepSpeech-style bi-LSTM + CTC model for AN4 speech.
+
+Reference parity: the ``lstman4`` workload (SURVEY.md §2 C9 — DeepSpeech-like
+bi-LSTM with CTC loss on AN4 spectrograms). Input is a log-spectrogram
+``float[B, F, T]`` (161 frequency bins); a small conv front-end downsamples
+time, bidirectional LSTM layers model context, and a per-frame projection
+emits CTC label logits (blank = index 0, per ``optax.ctc_loss`` convention).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BiLSTM(nn.Module):
+    hidden: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        fwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype),
+                     name="fwd")
+        bwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype),
+                     name="bwd", reverse=True, keep_order=True)
+        return fwd(x) + bwd(x)  # sum-merge keeps width constant (DeepSpeech2)
+
+
+class LSTMAN4(nn.Module):
+    num_labels: int = 29          # blank + 26 letters + space + apostrophe
+    hidden: int = 512
+    num_layers: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, spec, train: bool = True):
+        # spec: float[B, F, T] -> logits float[B, T', num_labels]
+        x = spec.astype(self.dtype)[..., None]          # [B, F, T, 1]
+        x = jnp.transpose(x, (0, 2, 1, 3))              # [B, T, F, 1]
+        conv = nn.Conv(32, (11, 41), strides=(2, 2), dtype=self.dtype)
+        x = nn.hard_tanh(nn.BatchNorm(use_running_average=not train,
+                                      momentum=0.9, dtype=jnp.float32)(conv(x)))
+        conv2 = nn.Conv(32, (11, 21), strides=(1, 2), dtype=self.dtype)
+        x = nn.hard_tanh(nn.BatchNorm(use_running_average=not train,
+                                      momentum=0.9, dtype=jnp.float32)(conv2(x)))
+        b, t = x.shape[0], x.shape[1]
+        x = x.reshape((b, t, -1))                       # fold freq x chan
+        for i in range(self.num_layers):
+            x = BiLSTM(self.hidden, self.dtype, name=f"bilstm_{i}")(x)
+        return nn.Dense(self.num_labels, dtype=jnp.float32)(x)

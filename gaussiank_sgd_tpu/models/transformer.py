@@ -1,0 +1,130 @@
+"""Transformer-base encoder-decoder (WMT14 En-De target).
+
+BASELINE config 5 is a *new-framework* target with no counterpart in the
+reference's model zoo (SURVEY.md §2.2 note): Transformer-base
+(d_model 512, 6+6 layers, 8 heads, ffn 2048) trained 64-way DP with
+RandomK-vs-GaussianK compression. Pre-LN variant for stable training without
+the original's warmup fragility. bf16-capable compute dtype; params fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+def sinusoidal_positions(max_len: int, dim: int) -> np.ndarray:
+    pos = np.arange(max_len)[:, None]
+    div = np.exp(np.arange(0, dim, 2) * (-np.log(10000.0) / dim))
+    pe = np.zeros((max_len, dim), np.float32)
+    pe[:, 0::2] = np.sin(pos * div)
+    pe[:, 1::2] = np.cos(pos * div)
+    return pe
+
+
+class MLP(nn.Module):
+    dim: int
+    hidden: int
+    dropout: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(self.dim, dtype=self.dtype)(x)
+        return nn.Dropout(self.dropout, deterministic=not train)(x)
+
+
+class EncoderLayer(nn.Module):
+    dim: int
+    heads: int
+    ffn: int
+    dropout: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, mask, train: bool):
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, dtype=self.dtype,
+            dropout_rate=self.dropout, deterministic=not train)(h, h, mask=mask)
+        x = x + nn.Dropout(self.dropout, deterministic=not train)(h)
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        return x + MLP(self.dim, self.ffn, self.dropout, self.dtype)(h, train)
+
+
+class DecoderLayer(nn.Module):
+    dim: int
+    heads: int
+    ffn: int
+    dropout: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, y, enc, self_mask, cross_mask, train: bool):
+        h = nn.LayerNorm(dtype=jnp.float32)(y)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, dtype=self.dtype,
+            dropout_rate=self.dropout, deterministic=not train)(
+                h, h, mask=self_mask)
+        y = y + nn.Dropout(self.dropout, deterministic=not train)(h)
+        h = nn.LayerNorm(dtype=jnp.float32)(y)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=self.heads, dtype=self.dtype,
+            dropout_rate=self.dropout, deterministic=not train)(
+                h, enc, mask=cross_mask)
+        y = y + nn.Dropout(self.dropout, deterministic=not train)(h)
+        h = nn.LayerNorm(dtype=jnp.float32)(y)
+        return y + MLP(self.dim, self.ffn, self.dropout, self.dtype)(h, train)
+
+
+class Transformer(nn.Module):
+    vocab_size: int = 32000
+    dim: int = 512
+    heads: int = 8
+    enc_layers: int = 6
+    dec_layers: int = 6
+    ffn: int = 2048
+    dropout: float = 0.1
+    max_len: int = 512
+    pad_id: int = 0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, src, tgt, train: bool = True):
+        # src: int32[B, S], tgt: int32[B, T] (decoder input, shifted right)
+        # -> logits float[B, T, V]
+        embed = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype,
+                         name="shared_embed")  # shared src/tgt table
+        pe = jnp.asarray(sinusoidal_positions(self.max_len, self.dim))
+        scale = jnp.sqrt(jnp.float32(self.dim)).astype(self.dtype)
+
+        src_pad = (src != self.pad_id)                    # [B, S]
+        tgt_pad = (tgt != self.pad_id)                    # [B, T]
+        enc_mask = nn.make_attention_mask(src_pad, src_pad, dtype=self.dtype)
+        causal = nn.make_causal_mask(tgt, dtype=self.dtype)
+        dec_mask = nn.combine_masks(
+            nn.make_attention_mask(tgt_pad, tgt_pad, dtype=self.dtype), causal)
+        cross_mask = nn.make_attention_mask(tgt_pad, src_pad, dtype=self.dtype)
+
+        x = embed(src) * scale + pe[:src.shape[1]].astype(self.dtype)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for i in range(self.enc_layers):
+            x = EncoderLayer(self.dim, self.heads, self.ffn, self.dropout,
+                             self.dtype, name=f"enc_{i}")(x, enc_mask, train)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+
+        y = embed(tgt) * scale + pe[:tgt.shape[1]].astype(self.dtype)
+        y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        for i in range(self.dec_layers):
+            y = DecoderLayer(self.dim, self.heads, self.ffn, self.dropout,
+                             self.dtype, name=f"dec_{i}")(
+                                 y, x, dec_mask, cross_mask, train)
+        y = nn.LayerNorm(dtype=jnp.float32)(y)
+        # tied output projection (weight sharing with the embedding table)
+        logits = embed.attend(y.astype(jnp.float32))
+        return logits

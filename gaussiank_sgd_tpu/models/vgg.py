@@ -1,0 +1,42 @@
+"""VGG-16 (CIFAR variant with BatchNorm).
+
+Reference parity: ``models/vgg.py`` (SURVEY.md §2 C7); BASELINE config 2 is
+VGG-16 / CIFAR-10 with GaussianK at 0.1% density — the classic "big dense
+layers, tiny useful gradient" compression showcase.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# Standard VGG-16 layout; 'M' = 2x2 max-pool.
+_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+        512, 512, 512, "M", 512, 512, 512, "M")
+
+
+class VGG16(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    cfg: Sequence = _CFG
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        conv = partial(nn.Conv, kernel_size=(3, 3), padding=1, use_bias=False,
+                       dtype=self.dtype, param_dtype=jnp.float32)
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = conv(v)(x)
+                x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                         momentum=0.9, dtype=jnp.float32)(x))
+        x = x.reshape((x.shape[0], -1))  # 1x1x512 after 5 pools on 32x32
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)

@@ -1,0 +1,8 @@
+"""TPU kernels (Pallas) for the hot compression ops (SURVEY.md §7 stage 6)."""
+
+from .pallas_select import (fused_stats, multi_threshold_counts,
+                            pallas_gaussian_compress,
+                            pallas_threshold_estimate)
+
+__all__ = ["fused_stats", "multi_threshold_counts",
+           "pallas_gaussian_compress", "pallas_threshold_estimate"]

@@ -1,0 +1,91 @@
+"""gTop-k sparse allreduce — butterfly exchange via ``lax.ppermute``.
+
+Reference parity: ``gtopk_sparse_allreduce`` in ``allreducer.py``
+(SURVEY.md §2 C3, §2.3 "gTop-k tree allreduce"): instead of allgathering
+P*k entries, run log2(P) pairwise rounds; each round exchanges the current
+k sparse entries with a partner, sum-merges colliding indices, and
+re-selects the top-k by magnitude. After the butterfly, every worker holds
+the SAME global top-k — communication is k entries per round
+(k*log2(P) total vs P*k for allgather), the win when P is large or the
+link (DCN) is thin.
+
+TPU-native design: the reference does this on a background mpi4py thread
+with MPI.Sendrecv (SURVEY.md §3.3); here each round is a ``lax.ppermute``
+with the XOR-partner permutation inside the jitted step — XLA schedules the
+log2(P) hops on ICI back-to-back, no threads, no handles. The merge
+(dedup-sum + reselect) works on [2k]-sized buffers only: sort by index,
+segment-sum duplicate indices, ``lax.top_k`` by |value| — never touching a
+dense [numel] buffer until the final decompress.
+
+EF semantics (matching the reference's gTop-k residual update): the caller
+zeroes its residual at globally-selected indices (``global_residual``).
+Locally-selected entries that LOST the global merge stay in the residual;
+note the converse does drop mass — a worker whose small acc[i] was never
+transmitted still zeroes i when OTHER workers put i in the global set
+(the global value simply doesn't include its contribution). That is the
+published algorithm's behavior, kept for parity; the allgather exchange
+(trainstep.py default) has exact per-worker EF.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..compressors.base import CompressedGrad
+
+
+def merge_sparse(idx_a: jax.Array, val_a: jax.Array, idx_b: jax.Array,
+                 val_b: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """Sum-merge two k-entry sparse sets, keep the top-k by |value|.
+
+    Padding entries (value 0) lose every top-k comparison against real
+    entries, so they only survive when fewer than k real entries exist —
+    preserving the fixed-k packing contract. Colliding indices sum, matching
+    the reference's merge (SURVEY.md §2.3).
+    """
+    cat_idx = jnp.concatenate([idx_a, idx_b])          # [2k]
+    cat_val = jnp.concatenate([val_a, val_b])
+    order = jnp.argsort(cat_idx)
+    s_idx = cat_idx[order]
+    s_val = cat_val[order]
+    # segment ids: 0,0,1,2,2,... equal adjacent indices share a segment
+    new_seg = jnp.concatenate([jnp.ones((1,), jnp.int32),
+                               (s_idx[1:] != s_idx[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(new_seg) - 1                      # [2k]
+    n2 = cat_idx.shape[0]
+    summed = jax.ops.segment_sum(s_val, seg, num_segments=n2)
+    seg_idx = jnp.zeros((n2,), s_idx.dtype).at[seg].set(s_idx)
+    # top-k by magnitude over the (<=2k) merged segments
+    _, top = lax.top_k(jnp.abs(summed), k)
+    return seg_idx[top].astype(jnp.int32), summed[top]
+
+
+def gtopk_allreduce(comp: CompressedGrad, num_devices: int,
+                    axis_name: str) -> CompressedGrad:
+    """Butterfly gTop-k: log2(P) ppermute rounds; result identical on every
+    worker (the global top-k of the summed sparse gradients, k entries)."""
+    p = num_devices
+    assert p & (p - 1) == 0, f"gtopk needs power-of-2 workers, got {p}"
+    k = comp.indices.shape[0]
+    idx, val = comp.indices, comp.values
+    for r in range(int(math.log2(p))):
+        stride = 1 << r
+        perm = [(j, j ^ stride) for j in range(p)]
+        o_idx = lax.ppermute(idx, axis_name, perm)
+        o_val = lax.ppermute(val, axis_name, perm)
+        idx, val = merge_sparse(idx, val, o_idx, o_val, k)
+    return CompressedGrad(idx, val)
+
+
+def global_residual(acc: jax.Array, global_comp: CompressedGrad) -> jax.Array:
+    """EF residual for the gTop-k path: zero exactly the globally-selected
+    indices (value-0 padding slots are dropped, not index 0)."""
+    n = acc.shape[0]
+    live = global_comp.values != 0
+    tgt = jnp.where(live, global_comp.indices, n)      # n == out of range
+    return acc.at[tgt].set(0.0, mode="drop")

@@ -34,8 +34,16 @@ _MULTIHOST_ENV_VARS = (
     "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
     "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
     "MEGASCALE_COORDINATOR_ADDRESS",
-    "TPU_WORKER_HOSTNAMES",  # multi-host TPU slice metadata
 )
+
+
+def _looks_multihost() -> bool:
+    if any(os.environ.get(v) for v in _MULTIHOST_ENV_VARS):
+        return True
+    # TPU slice metadata: multi-host only when several workers are listed
+    # (single-host tunnels set TPU_WORKER_HOSTNAMES=localhost)
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()]) > 1
 
 
 def maybe_initialize_distributed() -> None:
@@ -53,7 +61,7 @@ def maybe_initialize_distributed() -> None:
     try:
         jax.distributed.initialize()
     except Exception as e:  # noqa: BLE001 — classified below
-        if any(os.environ.get(v) for v in _MULTIHOST_ENV_VARS):
+        if _looks_multihost():
             raise RuntimeError(
                 "multi-host launch detected (coordinator env vars set) but "
                 "jax.distributed.initialize() failed — refusing to continue "

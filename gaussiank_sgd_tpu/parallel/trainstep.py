@@ -28,6 +28,7 @@ carries no warm-up branching.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -37,7 +38,7 @@ from jax import lax, shard_map
 from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..compressors.base import CompressedGrad
+from ..compressors.base import CompressedGrad, decompress
 from ..compressors.registry import CompressorSpec
 from .bucketing import BucketPlan
 
@@ -53,7 +54,9 @@ class TrainState(NamedTuple):
     """
 
     step: jax.Array          # int32 scalar (replicated)
-    params: Any              # model pytree (replicated)
+    params: Any              # trainable pytree (replicated)
+    model_state: Any         # non-trainable collections, e.g. BatchNorm
+                             # running stats (replicated; dp-meaned each step)
     opt_state: optax.OptState  # (replicated)
     ef_residual: jax.Array   # float32[num_devices, total_numel], sharded(dp)
     rng: jax.Array           # PRNG key (replicated)
@@ -69,23 +72,27 @@ class StepMetrics(NamedTuple):
     bytes_sent: jax.Array     # int32: per-worker payload of this step's exchange
 
 
-# loss_fn(params, batch, rng) -> (scalar loss, aux pytree)
-LossFn = Callable[[Any, Any, jax.Array], Tuple[jax.Array, Any]]
+# loss_fn(params, model_state, batch, rng)
+#   -> (scalar loss, (new_model_state, aux pytree))
+# ``model_state`` carries non-trainable collections (BatchNorm running stats);
+# pure-param models pass/return an empty dict.
+LossFn = Callable[[Any, Any, Any, jax.Array], Tuple[jax.Array, Any]]
 
 
-def _microbatch_grads(loss_fn: LossFn, params: Any, batch: Any,
-                      rng: jax.Array, num_microbatches: int):
+def _microbatch_grads(loss_fn: LossFn, params: Any, model_state: Any,
+                      batch: Any, rng: jax.Array, num_microbatches: int):
     """Local grads, averaged over ``num_microbatches`` sequential microbatches.
 
     Reference parity: ``--nsteps-update`` gradient accumulation
     (SURVEY.md §2.2). The local batch's leading dim is split into
     ``num_microbatches`` equal chunks and scanned — constant memory in the
-    accumulation factor.
+    accumulation factor. ``model_state`` threads through the microbatches
+    sequentially (last microbatch's stats win, like sequential torch steps).
     """
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     if num_microbatches <= 1:
-        (loss, aux), grads = grad_fn(params, batch, rng)
-        return loss, aux, grads
+        (loss, (mstate, aux)), grads = grad_fn(params, model_state, batch, rng)
+        return loss, mstate, aux, grads
 
     def split(x):
         return x.reshape((num_microbatches, x.shape[0] // num_microbatches)
@@ -96,18 +103,18 @@ def _microbatch_grads(loss_fn: LossFn, params: Any, batch: Any,
 
     def body(carry, mb_rng):
         mb_i, rng_i = mb_rng
-        (loss, aux), grads = grad_fn(params, mb_i, rng_i)
-        c_loss, c_aux, c_grads = carry
-        return ((c_loss + loss, jax.tree.map(jnp.add, c_aux, aux),
+        c_loss, c_mstate, c_aux, c_grads = carry
+        (loss, (mstate, aux)), grads = grad_fn(params, c_mstate, mb_i, rng_i)
+        return ((c_loss + loss, mstate, jax.tree.map(jnp.add, c_aux, aux),
                  jax.tree.map(jnp.add, c_grads, grads)), None)
 
-    (loss0, aux0), grads0 = grad_fn(params, jax.tree.map(lambda x: x[0], mb),
-                                    rngs[0])
-    (loss, aux, grads), _ = lax.scan(
-        body, (loss0, aux0, grads0),
+    (loss0, (mstate0, aux0)), grads0 = grad_fn(
+        params, model_state, jax.tree.map(lambda x: x[0], mb), rngs[0])
+    (loss, mstate, aux, grads), _ = lax.scan(
+        body, (loss0, mstate0, aux0, grads0),
         (jax.tree.map(lambda x: x[1:], mb), rngs[1:]))
     inv = 1.0 / num_microbatches
-    return (loss * inv, jax.tree.map(lambda x: x * inv, aux),
+    return (loss * inv, mstate, jax.tree.map(lambda x: x * inv, aux),
             jax.tree.map(lambda x: x * inv, grads))
 
 
@@ -154,7 +161,8 @@ class DPTrainStep(NamedTuple):
 
     sparse_step: Callable[[TrainState, Any], Tuple[TrainState, StepMetrics]]
     dense_step: Callable[[TrainState, Any], Tuple[TrainState, StepMetrics]]
-    init_state: Callable[[Any, jax.Array], TrainState]
+    # (params, rng, model_state=None) -> TrainState
+    init_state: Callable[..., TrainState]
     plan: BucketPlan
     mesh: Mesh
 
@@ -170,6 +178,7 @@ def build_dp_train_step(
     clip_norm: Optional[float] = None,
     fold_lr: Optional[Callable[[jax.Array], jax.Array]] = None,
     grad_dtype=jnp.float32,
+    exchange: str = "allgather",
 ) -> DPTrainStep:
     """Build the data-parallel train step over ``mesh``.
 
@@ -184,8 +193,18 @@ def build_dp_train_step(
     with a hierarchical mesh the sparse all-gather stays on the (fast) last
     axis and only an already-dense partial crosses the first axis
     (SURVEY.md §7 hard part 3).
+
+    ``exchange``: ``'allgather'`` (the reference's C2 path / north-star) or
+    ``'gtopk'`` (the reference's C3 gTop-k tree allreduce, rebuilt as a
+    ppermute butterfly — parallel/gtopk.py; 1-D power-of-2 meshes only).
     """
     axes = tuple(mesh.axis_names)
+    if exchange == "gtopk":
+        assert len(axes) == 1, "gtopk exchange supports 1-D dp meshes only"
+        assert mesh.size & (mesh.size - 1) == 0, \
+            "gtopk exchange needs a power-of-2 dp width"
+    elif exchange != "allgather":
+        raise ValueError(f"unknown exchange {exchange!r}")
     gather_axis = axes[-1]          # ICI axis on hierarchical meshes
     outer_axes = axes[:-1]          # DCN axes (empty on 1-D meshes)
     n_total = plan.total_numel
@@ -223,49 +242,71 @@ def build_dp_train_step(
         return data_rng, comp_rng
 
     def _local_grads(state: TrainState, batch: Any, data_rng: jax.Array):
-        loss, aux, grads = _microbatch_grads(
-            loss_fn, state.params, batch, data_rng, num_microbatches)
+        loss, mstate, aux, grads = _microbatch_grads(
+            loss_fn, state.params, state.model_state, batch, data_rng,
+            num_microbatches)
         flat_g, unravel = ravel_pytree(grads)
         flat_g = _clip_by_global_norm(flat_g.astype(grad_dtype), clip_norm)
-        # dp-mean of loss/aux for logging (grads are exchanged separately)
-        return _pmean(loss), jax.tree.map(_pmean, aux), flat_g, unravel
+        # dp-mean of loss/aux/model-state for logging & replicated-stats
+        # consistency (BatchNorm running stats are averaged across workers —
+        # strictly better than the reference's per-GPU local stats).
+        def pmean_floats(x):
+            return _pmean(x) if jnp.issubdtype(x.dtype, jnp.floating) else x
+        mstate = jax.tree.map(pmean_floats, mstate)
+        return (_pmean(loss), mstate, jax.tree.map(_pmean, aux), flat_g,
+                unravel)
 
-    def _apply(state: TrainState, dense_flat: jax.Array, unravel,
+    def _apply(state: TrainState, mstate: Any, dense_flat: jax.Array, unravel,
                new_residual: jax.Array):
         updates, opt_state = optimizer.update(
             unravel(dense_flat), state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        return TrainState(state.step + 1, params, opt_state, new_residual,
-                          state.rng)
+        return TrainState(state.step + 1, params, mstate, opt_state,
+                          new_residual, state.rng)
 
     def sparse_step_fn(state: TrainState, batch: Any):
         data_rng, comp_rng = _step_rngs(state)
-        loss, aux, flat_g, unravel = _local_grads(state, batch, data_rng)
+        loss, mstate, aux, flat_g, unravel = _local_grads(state, batch,
+                                                          data_rng)
         scale = fold_lr(state.step) if fold_lr is not None else 1.0
         acc = state.ef_residual[0] + scale * flat_g  # local residual row
         comp, residual, nsel = compress_buckets(spec, plan, acc, comp_rng)
-
-        # ONE all-gather of the packed pairs over the (ICI) gather axis,
-        # scatter-summed dense; hierarchical meshes psum the dense partial
-        # across the outer (DCN) axes (collectives.py documents the math).
-        g_idx = lax.all_gather(comp.indices, gather_axis, tiled=True)
-        g_val = lax.all_gather(comp.values, gather_axis, tiled=True)
-        dense = jnp.zeros((n_total,), grad_dtype).at[g_idx].add(
-            g_val.astype(grad_dtype))
-        for a in outer_axes:
-            dense = lax.psum(dense, a)
-        dense = dense / _all_axes_size()
-
-        new_state = _apply(state, dense, unravel, residual[None, :])
         k_packed = comp.indices.shape[0]
-        bytes_sent = jnp.int32(k_packed * (4 + comp.values.dtype.itemsize))
+
+        if exchange == "gtopk":
+            # butterfly gTop-k: k entries per round, log2(P) rounds; the
+            # global top-k is identical on every worker (gtopk.py). EF keeps
+            # everything not globally selected.
+            from .gtopk import global_residual, gtopk_allreduce
+            gcomp = gtopk_allreduce(comp, mesh.size, gather_axis)
+            dense = decompress(gcomp, n_total, grad_dtype) / _all_axes_size()
+            residual = global_residual(acc, gcomp)
+            bytes_sent = jnp.int32(
+                k_packed * (4 + comp.values.dtype.itemsize)
+                * max(1, int(math.log2(mesh.size))))
+        else:
+            # ONE all-gather of the packed pairs over the (ICI) gather axis,
+            # scatter-summed dense; hierarchical meshes psum the dense
+            # partial across the outer (DCN) axes (collectives.py).
+            g_idx = lax.all_gather(comp.indices, gather_axis, tiled=True)
+            g_val = lax.all_gather(comp.values, gather_axis, tiled=True)
+            dense = decompress(CompressedGrad(g_idx, g_val), n_total,
+                               grad_dtype)
+            for a in outer_axes:
+                dense = lax.psum(dense, a)
+            dense = dense / _all_axes_size()
+            bytes_sent = jnp.int32(
+                k_packed * (4 + comp.values.dtype.itemsize))
+
+        new_state = _apply(state, mstate, dense, unravel, residual[None, :])
         return new_state, StepMetrics(
             loss, aux, _pmean(jnp.linalg.norm(flat_g)),
             _pmean(nsel.astype(jnp.float32)), bytes_sent)
 
     def dense_step_fn(state: TrainState, batch: Any):
         data_rng, _ = _step_rngs(state)
-        loss, aux, flat_g, unravel = _local_grads(state, batch, data_rng)
+        loss, mstate, aux, flat_g, unravel = _local_grads(state, batch,
+                                                          data_rng)
         scale = fold_lr(state.step) if fold_lr is not None else 1.0
         dense = scale * flat_g
         for a in axes:
@@ -273,7 +314,7 @@ def build_dp_train_step(
         dense = dense / _all_axes_size()
         # Warm-up is compression-off: the EF residual is untouched (and zero
         # if warm-up precedes any sparse step), matching SURVEY.md §2.3.
-        new_state = _apply(state, dense, unravel, state.ef_residual)
+        new_state = _apply(state, mstate, dense, unravel, state.ef_residual)
         return new_state, StepMetrics(
             loss, aux, _pmean(jnp.linalg.norm(flat_g)),
             jnp.float32(n_total), jnp.int32(n_total * 4))
@@ -281,8 +322,8 @@ def build_dp_train_step(
     batch_spec = P(axes)            # leading dim sharded over every dp axis
     # Pytree-prefix specs: everything in TrainState is replicated except the
     # per-worker ef_residual, which shards its leading [num_devices] dim.
-    state_spec = TrainState(step=P(), params=P(), opt_state=P(),
-                            ef_residual=P(axes), rng=P())
+    state_spec = TrainState(step=P(), params=P(), model_state=P(),
+                            opt_state=P(), ef_residual=P(axes), rng=P())
 
     def _wrap(fn):
         smapped = shard_map(
@@ -293,7 +334,8 @@ def build_dp_train_step(
         )
         return jax.jit(smapped, donate_argnums=(0,))
 
-    def init_state(params: Any, rng: jax.Array) -> TrainState:
+    def init_state(params: Any, rng: jax.Array,
+                   model_state: Any = None) -> TrainState:
         flat, _ = ravel_pytree(params)
         assert flat.size == n_total, (
             f"bucket plan built for {n_total} params, model has {flat.size}")
@@ -301,9 +343,12 @@ def build_dp_train_step(
         # param buffers are never invalidated (and two states can share an
         # init pytree).
         params = jax.tree.map(jnp.copy, params)
+        model_state = jax.tree.map(jnp.copy, {} if model_state is None
+                                   else model_state)
         return TrainState(
             step=jnp.int32(0),
             params=params,
+            model_state=model_state,
             opt_state=optimizer.init(params),
             ef_residual=jnp.zeros((mesh.size, n_total), grad_dtype),
             rng=rng,

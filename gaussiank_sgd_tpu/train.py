@@ -1,0 +1,49 @@
+"""Distributed training entrypoint.
+
+Reference parity: ``horovod_trainer.py`` (SURVEY.md §2 C6, §3.1) — the
+argparse CLI, process/device initialization, trainer construction, and the
+epoch loop. The launch model is TPU-native: instead of
+``mpirun -np P python horovod_trainer.py``, run ONE process per host
+(``python -m gaussiank_sgd_tpu.train ...``); `jax.distributed` + the slice
+topology replace MPI rank discovery (SURVEY.md §2.1), and the dp width is
+the device mesh, not a process count.
+
+Examples (mirroring the reference's launch scripts, SURVEY.md §2 C12):
+  # dense single-worker smoke (BASELINE config 1)
+  python -m gaussiank_sgd_tpu.train --dnn resnet20 --dataset cifar10 \
+      --nworkers 1 --compressor none --epochs 1 --max-steps 20
+
+  # 8-way GaussianK at 0.1% density (BASELINE config 2 shape)
+  python -m gaussiank_sgd_tpu.train --dnn vgg16 --dataset cifar10 \
+      --nworkers 8 --compressor gaussian --density 0.001 \
+      --compress-warmup-steps 100
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .parallel.mesh import maybe_initialize_distributed
+from .training.config import add_args, from_args
+from .training.trainer import Trainer
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="TPU-native communication-compressed data-parallel "
+                    "training (GaussianK-SGD capability surface)")
+    add_args(p)
+    args = p.parse_args(argv)
+    maybe_initialize_distributed()
+    cfg = from_args(args)
+    trainer = Trainer(cfg)
+    try:
+        result = trainer.fit()
+        trainer.logger.info("done: %s", result)
+        return result
+    finally:
+        trainer.close()
+
+
+if __name__ == "__main__":
+    main()

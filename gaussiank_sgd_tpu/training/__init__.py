@@ -1,0 +1,7 @@
+"""Trainer runtime (reference parity: ``dl_trainer.py`` + entry scripts —
+SURVEY.md §2 C5/C6/C10/C11)."""
+
+from .config import TrainConfig, add_args, from_args
+from .trainer import Trainer
+
+__all__ = ["TrainConfig", "Trainer", "add_args", "from_args"]

@@ -1,0 +1,85 @@
+"""Checkpoint / resume via orbax.
+
+Reference parity: the periodic rank-0 ``torch.save`` + manual resume
+(SURVEY.md §3.5). Per the survey's note, the rebuild checkpoints the FULL
+training state — params, optimizer state, **the sharded per-worker EF
+residuals** (un-sent gradient mass is training state; the reference likely
+drops it), model_state (BatchNorm stats), PRNG key, and the step counter —
+so resume is exact; the trainer separately realigns its data stream to the
+restored step (``Trainer._stream``: epoch-seeded shuffle + in-epoch skip).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import orbax.checkpoint as ocp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.trainstep import TrainState
+
+
+def save_checkpoint(ckpt_dir: str, state: TrainState) -> str:
+    """Write a checkpoint for the current step; returns its path.
+
+    Idempotent per step: a checkpoint that already exists for this step is
+    left in place (covers epoch-boundary + final-save landing on the same
+    step, and reruns over an existing run dir).
+    """
+    step = int(jax.device_get(state.step))
+    path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
+    if os.path.exists(path):
+        return path
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, state)
+    ckptr.wait_until_finished()
+    return path
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    steps = [d for d in os.listdir(ckpt_dir) if d.startswith("step_")]
+    if not steps:
+        return None
+    return os.path.join(os.path.abspath(ckpt_dir), sorted(steps)[-1])
+
+
+def restore_checkpoint(path: str, target: TrainState,
+                       mesh: Optional[Mesh] = None) -> TrainState:
+    """Restore into the structure of ``target`` with live mesh shardings.
+
+    With ``mesh`` given, every leaf restores replicated over the mesh EXCEPT
+    ``ef_residual``, which restores sharded over the dp axes (its leading
+    [num_devices] dim) — exactly the layout build_dp_train_step expects.
+    Orbax restores COMMITTED arrays, so restoring with the raw shardings of a
+    freshly-initialized target (single-device, uncommitted) would pin
+    everything to device 0 and break the next jitted step.
+    """
+    ckptr = ocp.StandardCheckpointer()
+
+    def sds(x, sharding=None):
+        if not isinstance(x, jax.Array):
+            return x
+        return jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=sharding or x.sharding)
+
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        dp = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+        abstract = TrainState(
+            step=sds(target.step, repl),
+            params=jax.tree.map(lambda x: sds(x, repl), target.params),
+            model_state=jax.tree.map(lambda x: sds(x, repl),
+                                     target.model_state),
+            opt_state=jax.tree.map(lambda x: sds(x, repl), target.opt_state),
+            ef_residual=sds(target.ef_residual, dp),
+            rng=sds(target.rng, repl),
+        )
+    else:
+        abstract = jax.tree.map(sds, target)
+    restored = ckptr.restore(path, abstract)
+    return TrainState(*restored) if not isinstance(restored, TrainState) \
+        else restored

@@ -1,0 +1,127 @@
+"""Run configuration — one dataclass, CLI-overridable.
+
+Reference parity: the argparse surface of ``horovod_trainer.py``
+(SURVEY.md §2 C6: ``--dnn --dataset --batch-size --lr --nworkers
+--nwpernode --nsteps-update --compressor --density --sigma-scale ...``) plus
+the hardcoded constants scattered through ``settings.py`` (SURVEY.md §2 C10),
+consolidated into a single typed config (SURVEY.md §5 "Config / flag
+system" rebuild note).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+
+@dataclass
+class TrainConfig:
+    # model / data (reference --dnn / --dataset / --data-dir)
+    dnn: str = "resnet20"
+    dataset: str = "cifar10"
+    data_dir: Optional[str] = None          # None/'synthetic' -> synthetic
+    num_classes: Optional[int] = None
+
+    # batch geometry (reference --batch-size is PER WORKER; global = bs * P)
+    batch_size: int = 32                    # per worker
+    nsteps_update: int = 1                  # gradient accumulation factor
+    nworkers: int = 1                       # dp size; 0 -> all devices
+    ici_size: int = 0                       # >0 with dcn_size: hierarchical
+    dcn_size: int = 0                       #   (dcn_dp, ici_dp) mesh
+
+    # optimization (reference SGD defaults)
+    lr: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 5e-4
+    nesterov: bool = False
+    epochs: int = 90
+    max_steps: Optional[int] = None         # hard cap (overrides epochs)
+    lr_milestones: Tuple[float, ...] = (0.5, 0.75)  # fractions of total steps
+    lr_decay: float = 0.1
+    warmup_epochs: float = 5.0              # LR warmup (multi-worker scaling)
+    clip_norm: Optional[float] = None       # grad clipping (LSTM: 0.25)
+    label_smoothing: float = 0.0            # transformer: 0.1
+
+    # compression (reference --compressor/--density/--sigma-scale)
+    compressor: str = "none"
+    density: float = 0.001
+    sigma_scale: Optional[float] = None
+    bucket_size: Optional[int] = None       # None=whole-model, 0=per-tensor
+    compress_warmup_steps: int = 0          # dense allreduce for first N steps
+    fold_lr: bool = False                   # EF on lr-scaled grads (§2.3 note)
+
+    # numerics
+    compute_dtype: str = "bfloat16"         # MXU-native compute
+    seed: int = 42
+
+    # io / logging / checkpoints (reference settings.py + torch.save path)
+    run_id: str = "run"
+    output_dir: str = "./runs"
+    log_every: int = 10                     # reference display-freq
+    eval_every_epochs: int = 1
+    save_every_epochs: int = 10
+    resume: Optional[str] = None            # checkpoint dir to resume from
+    profile_steps: Optional[Tuple[int, int]] = None  # jax.profiler window
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), default=str, indent=2)
+
+    @property
+    def global_batch_size(self) -> int:
+        return self.batch_size * max(1, self.nworkers) * self.nsteps_update
+
+
+def add_args(p: argparse.ArgumentParser) -> None:
+    """CLI flags named as in the reference entrypoint (SURVEY.md §2 C6)."""
+    d = TrainConfig()
+    p.add_argument("--dnn", default=d.dnn)
+    p.add_argument("--dataset", default=d.dataset)
+    p.add_argument("--data-dir", dest="data_dir", default=d.data_dir)
+    p.add_argument("--batch-size", dest="batch_size", type=int,
+                   default=d.batch_size, help="per-worker batch size")
+    p.add_argument("--nsteps-update", dest="nsteps_update", type=int,
+                   default=d.nsteps_update)
+    p.add_argument("--nworkers", type=int, default=d.nworkers,
+                   help="dp width; 0 = all visible devices")
+    p.add_argument("--ici-size", dest="ici_size", type=int, default=d.ici_size)
+    p.add_argument("--dcn-size", dest="dcn_size", type=int, default=d.dcn_size)
+    p.add_argument("--lr", type=float, default=d.lr)
+    p.add_argument("--momentum", type=float, default=d.momentum)
+    p.add_argument("--weight-decay", dest="weight_decay", type=float,
+                   default=d.weight_decay)
+    p.add_argument("--nesterov", action="store_true")
+    p.add_argument("--epochs", type=int, default=d.epochs)
+    p.add_argument("--max-steps", dest="max_steps", type=int, default=None)
+    p.add_argument("--warmup-epochs", dest="warmup_epochs", type=float,
+                   default=d.warmup_epochs)
+    p.add_argument("--clip-norm", dest="clip_norm", type=float, default=None)
+    p.add_argument("--label-smoothing", dest="label_smoothing", type=float,
+                   default=d.label_smoothing)
+    p.add_argument("--compressor", default=d.compressor,
+                   help="none|topk|gaussian|randomk|randomkec|dgcsampling|"
+                        "redsync|redsynctrim")
+    p.add_argument("--density", type=float, default=d.density)
+    p.add_argument("--sigma-scale", dest="sigma_scale", type=float,
+                   default=None)
+    p.add_argument("--bucket-size", dest="bucket_size", type=int, default=None)
+    p.add_argument("--compress-warmup-steps", dest="compress_warmup_steps",
+                   type=int, default=d.compress_warmup_steps)
+    p.add_argument("--fold-lr", dest="fold_lr", action="store_true")
+    p.add_argument("--compute-dtype", dest="compute_dtype",
+                   default=d.compute_dtype)
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--run-id", dest="run_id", default=d.run_id)
+    p.add_argument("--output-dir", dest="output_dir", default=d.output_dir)
+    p.add_argument("--log-every", dest="log_every", type=int,
+                   default=d.log_every)
+    p.add_argument("--save-every-epochs", dest="save_every_epochs", type=int,
+                   default=d.save_every_epochs)
+    p.add_argument("--resume", default=None)
+
+
+def from_args(args: argparse.Namespace) -> TrainConfig:
+    fields = {f.name for f in dataclasses.fields(TrainConfig)}
+    return TrainConfig(**{k: v for k, v in vars(args).items() if k in fields})

@@ -1,0 +1,147 @@
+"""Per-task loss functions, shaped for the train step's LossFn contract
+``(params, model_state, batch, rng) -> (loss, (model_state', aux))``.
+
+Reference parity: the loss dispatch in ``DLTrainer`` (SURVEY.md §3.2 —
+"CE / CTC(an4) / CE-per-token(ptb)"), plus label-smoothed seq2seq CE for the
+Transformer target (BASELINE config 5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..models import ModelSpec
+
+
+def _apply(spec: ModelSpec, params, mstate, rng, *inputs):
+    """Train-mode apply, threading mutable collections + dropout rng."""
+    variables = {"params": params, **mstate}
+    mutable = [k for k in mstate.keys()]
+    kwargs = dict(train=True, rngs={"dropout": rng})
+    if mutable:
+        out, updated = spec.module.apply(variables, *inputs,
+                                         mutable=mutable, **kwargs)
+        return out, updated
+    return spec.module.apply(variables, *inputs, **kwargs), mstate
+
+
+def make_loss_fn(spec: ModelSpec, label_smoothing: float = 0.0) -> Callable:
+    task = spec.task
+
+    if task == "classify":
+        def loss_fn(params, mstate, batch, rng):
+            x, y = batch
+            logits, mstate = _apply(spec, params, mstate, rng, x)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            acc = (logits.argmax(-1) == y).astype(jnp.float32).mean()
+            return loss, (mstate, {"acc": acc})
+        return loss_fn
+
+    if task == "lm":
+        def loss_fn(params, mstate, batch, rng):
+            x, y = batch
+            logits, mstate = _apply(spec, params, mstate, rng, x)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            # perplexity = exp(loss); report loss, exp on host
+            return loss, (mstate, {"ce_per_token": loss})
+        return loss_fn
+
+    if task == "ctc":
+        def loss_fn(params, mstate, batch, rng):
+            x, labels = batch
+            logits, mstate = _apply(spec, params, mstate, rng, x)
+            logit_pad = jnp.zeros(logits.shape[:2], jnp.float32)
+            label_pad = (labels == 0).astype(jnp.float32)
+            loss = optax.ctc_loss(logits, logit_pad, labels,
+                                  label_pad).mean()
+            return loss, (mstate, {"ctc": loss})
+        return loss_fn
+
+    if task == "seq2seq":
+        def loss_fn(params, mstate, batch, rng):
+            src, tgt = batch
+            # teacher forcing: decoder input is tgt shifted right (BOS=pad 0)
+            dec_in = jnp.pad(tgt[:, :-1], ((0, 0), (1, 0)))
+            logits, mstate = _apply(spec, params, mstate, rng, src, dec_in)
+            mask = (tgt != 0).astype(jnp.float32)
+            if label_smoothing > 0:
+                n = logits.shape[-1]
+                onehot = jax.nn.one_hot(tgt, n)
+                soft = onehot * (1 - label_smoothing) + label_smoothing / n
+                ce = optax.softmax_cross_entropy(logits, soft)
+            else:
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, tgt)
+            loss = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+            acc = (((logits.argmax(-1) == tgt) * mask).sum()
+                   / jnp.maximum(mask.sum(), 1.0))
+            return loss, (mstate, {"acc": acc})
+        return loss_fn
+
+    raise ValueError(f"unknown task {task!r}")
+
+
+def make_eval_fn(spec: ModelSpec) -> Callable:
+    """(params, mstate, batch) -> dict of SUMS (caller psums + normalizes).
+
+    Eval-mode apply (train=False, running BatchNorm stats, no dropout).
+    Returns sums so distributed eval just adds across shards — top-1/top-5/
+    val-loss/perplexity exactly as the reference's test loop (SURVEY.md §2 C5).
+    """
+    task = spec.task
+
+    def apply_eval(params, mstate, *inputs):
+        return spec.module.apply({"params": params, **mstate}, *inputs,
+                                 train=False)
+
+    if task == "classify":
+        def eval_fn(params, mstate, batch):
+            x, y = batch
+            logits = apply_eval(params, mstate, x)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            top1 = (logits.argmax(-1) == y).sum()
+            top5 = (jax.lax.top_k(logits, min(5, logits.shape[-1]))[1]
+                    == y[:, None]).any(-1).sum()
+            return {"loss_sum": ce.sum(), "top1": top1.astype(jnp.float32),
+                    "top5": top5.astype(jnp.float32),
+                    "n": jnp.float32(y.shape[0])}
+        return eval_fn
+
+    if task == "lm":
+        def eval_fn(params, mstate, batch):
+            x, y = batch
+            logits = apply_eval(params, mstate, x)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return {"loss_sum": ce.sum(),
+                    "n": jnp.float32(y.shape[0] * y.shape[1])}
+        return eval_fn
+
+    if task == "ctc":
+        def eval_fn(params, mstate, batch):
+            x, labels = batch
+            logits = apply_eval(params, mstate, x)
+            logit_pad = jnp.zeros(logits.shape[:2], jnp.float32)
+            label_pad = (labels == 0).astype(jnp.float32)
+            loss = optax.ctc_loss(logits, logit_pad, labels, label_pad)
+            return {"loss_sum": loss.sum(), "n": jnp.float32(labels.shape[0])}
+        return eval_fn
+
+    if task == "seq2seq":
+        def eval_fn(params, mstate, batch):
+            src, tgt = batch
+            dec_in = jnp.pad(tgt[:, :-1], ((0, 0), (1, 0)))
+            logits = apply_eval(params, mstate, src, dec_in)
+            mask = (tgt != 0).astype(jnp.float32)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, tgt)
+            top1 = ((logits.argmax(-1) == tgt) * mask).sum()
+            return {"loss_sum": (ce * mask).sum(), "top1": top1,
+                    "n": mask.sum()}
+        return eval_fn
+
+    raise ValueError(f"unknown task {task!r}")

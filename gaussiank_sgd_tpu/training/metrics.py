@@ -1,0 +1,93 @@
+"""Logging & metrics.
+
+Reference parity: ``settings.py``'s global logger (console + per-run file,
+SURVEY.md §2 C10) and the log-line metrics its plot scripts parse
+(SURVEY.md §5 "Metrics / logging"). Rebuilt per the survey's note as
+structured JSONL — one record per logged step with loss/acc/step-time/
+bytes-sent/density — alongside the human-readable lines.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+
+def make_logger(name: str = "gaussiank_sgd_tpu",
+                log_file: Optional[str] = None,
+                level=logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    logger.propagate = False
+    if not logger.handlers:
+        fmt = logging.Formatter(
+            "%(asctime)s [%(levelname)s] %(message)s", "%H:%M:%S")
+        sh = logging.StreamHandler(sys.stdout)
+        sh.setFormatter(fmt)
+        logger.addHandler(sh)
+        if log_file:
+            os.makedirs(os.path.dirname(log_file), exist_ok=True)
+            fh = logging.FileHandler(log_file)
+            fh.setFormatter(fmt)
+            logger.addHandler(fh)
+    return logger
+
+
+class JSONLWriter:
+    """Append-only JSONL metric stream (one dict per record)."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self._f = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._f = open(path, "a", buffering=1)
+
+    def write(self, record: Dict[str, Any]) -> None:
+        if self._f:
+            self._f.write(json.dumps(record, default=float) + "\n")
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()
+            self._f = None
+
+
+class PhaseTimers:
+    """Wall-clock phase timers: io / step (fwd+bwd+comm fused under XLA).
+
+    Reference parity: the io/fwd/bwd/comm breakdown in ``dl_trainer.py``
+    (SURVEY.md §3.2, §5 Tracing). One jitted program owns fwd+bwd+comm here,
+    so the honest breakdown is io vs device-step; finer slicing comes from
+    ``jax.profiler`` traces (trainer.profile hooks), not host timers.
+    """
+
+    def __init__(self):
+        self.sums: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+        self._t0: Optional[float] = None
+        self._phase: Optional[str] = None
+
+    def start(self, phase: str) -> None:
+        now = time.perf_counter()
+        if self._phase is not None:
+            self.sums[self._phase] = self.sums.get(self._phase, 0.0) + (
+                now - self._t0)
+            self.counts[self._phase] = self.counts.get(self._phase, 0) + 1
+        self._phase, self._t0 = phase, now
+
+    def stop(self) -> None:
+        self.start("_idle")
+        self._phase = None
+
+    def means(self) -> Dict[str, float]:
+        return {k: self.sums[k] / max(1, self.counts[k])
+                for k in self.sums if not k.startswith("_")}
+
+    def reset(self) -> None:
+        self.sums.clear()
+        self.counts.clear()

@@ -1,0 +1,325 @@
+"""Trainer — the L3/L4 runtime (reference parity: ``DLTrainer`` in
+``dl_trainer.py`` + the epoch loop of ``horovod_trainer.py``, SURVEY.md §2
+C5/C6 and §3.1/§3.2).
+
+Responsibilities, mapped from the reference:
+  model-zoo dispatch        -> models.get_model
+  dataset construction      -> data.make_dataset (+ background prefetch)
+  distributed optimizer     -> parallel.trainstep (built here)
+  LR schedule + warmup      -> training.lr_schedule (inside the jitted step)
+  warm-up dense allreduce   -> Python-side dense/sparse step selection
+  train/test loops, timers  -> Trainer.train / Trainer.test / PhaseTimers
+  checkpoints               -> training.checkpoint (orbax, full state)
+  metrics/logging           -> JSONL + human log lines
+
+Everything device-side lives in ONE jitted SPMD program per step kind; the
+trainer is a thin host loop feeding batches and draining metrics
+(SURVEY.md §7 design stance).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from .. import data as data_lib
+from .. import models as models_lib
+from ..compressors import get_compressor
+from ..parallel.bucketing import plan_for_params
+from ..parallel.mesh import (batch_sharded, data_parallel_mesh,
+                             hierarchical_dp_mesh, shard_batch)
+from ..parallel.trainstep import build_dp_train_step
+from .checkpoint import (latest_checkpoint, restore_checkpoint,
+                         save_checkpoint)
+from .config import TrainConfig
+from .losses import make_eval_fn, make_loss_fn
+from .lr_schedule import warmup_milestone_schedule
+from .metrics import JSONLWriter, PhaseTimers, make_logger
+
+
+def _dtype_of(name: str):
+    return {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+            "float32": jnp.float32, "fp32": jnp.float32}[name]
+
+
+class Trainer:
+    def __init__(self, cfg: TrainConfig):
+        self.cfg = cfg
+        run_dir = os.path.join(cfg.output_dir, cfg.run_id)
+        self.run_dir = run_dir
+        self.logger = make_logger(log_file=os.path.join(run_dir, "train.log"))
+        self.jsonl = JSONLWriter(os.path.join(run_dir, "metrics.jsonl"))
+        self.timers = PhaseTimers()
+
+        # ---- mesh (SURVEY.md §3.1: hvd.init + device binding -> mesh) ----
+        if cfg.ici_size > 0 and cfg.dcn_size > 0:
+            self.mesh = hierarchical_dp_mesh(cfg.ici_size, cfg.dcn_size)
+        else:
+            n = cfg.nworkers if cfg.nworkers > 0 else None
+            self.mesh = data_parallel_mesh(n)
+        self.nworkers = self.mesh.size
+
+        # ---- data first (its cardinality sizes the model head/vocab) ----
+        dtype = _dtype_of(cfg.compute_dtype)
+        local_bs = cfg.batch_size * self.nworkers * cfg.nsteps_update
+        self.train_ds, card = data_lib.make_dataset(
+            cfg.dataset, cfg.data_dir, train=True, batch_size=local_bs)
+        eval_bs = max(self.nworkers, local_bs // cfg.nsteps_update)
+        self.test_ds, _ = data_lib.make_dataset(
+            cfg.dataset, cfg.data_dir, train=False, batch_size=eval_bs)
+
+        # ---- model: head size = explicit flag > dataset cardinality ----
+        model_kw = {}
+        if cfg.dnn.lower() in ("lstm", "transformer"):
+            model_kw["vocab_size"] = cfg.num_classes or card
+        elif cfg.dnn.lower() == "lstman4":
+            model_kw["num_labels"] = cfg.num_classes or card
+        self.spec = models_lib.get_model(
+            cfg.dnn, cfg.dataset, num_classes=cfg.num_classes or card,
+            dtype=dtype, **model_kw)
+        self.steps_per_epoch = self.train_ds.steps_per_epoch
+        self.total_steps = (cfg.max_steps if cfg.max_steps
+                            else cfg.epochs * self.steps_per_epoch)
+
+        # ---- init model variables ----
+        rng = jax.random.PRNGKey(cfg.seed)
+        init_rng, self.data_rng, state_rng = jax.random.split(rng, 3)
+        dummy = self._dummy_inputs()
+        variables = self.spec.module.init(
+            {"params": init_rng, "dropout": init_rng}, *dummy, train=False)
+        params = variables["params"]
+        model_state = {k: v for k, v in variables.items() if k != "params"}
+        n_params = sum(int(np.prod(x.shape))
+                       for x in jax.tree_util.tree_leaves(params))
+
+        # ---- schedule + inner optimizer (torch-SGD-equivalent chain) ----
+        self.schedule = warmup_milestone_schedule(
+            cfg.lr, self.nworkers, self.steps_per_epoch, self.total_steps,
+            cfg.warmup_epochs, cfg.lr_milestones, cfg.lr_decay)
+        chain = []
+        if cfg.weight_decay:
+            # wd applied to the *exchanged* gradient, before momentum — the
+            # torch SGD placement the reference inherits (SURVEY.md §3.1)
+            chain.append(optax.add_decayed_weights(cfg.weight_decay))
+        lr_for_opt = (lambda s: 1.0) if cfg.fold_lr else self.schedule
+        chain.append(optax.sgd(lr_for_opt, momentum=cfg.momentum or None,
+                               nesterov=cfg.nesterov))
+        optimizer = optax.chain(*chain)
+
+        # ---- compression + the fused step ----
+        comp = get_compressor(cfg.compressor, density=cfg.density,
+                              sigma_scale=cfg.sigma_scale)
+        plan = plan_for_params(params, cfg.density, cfg.bucket_size)
+        self.plan = plan
+        self.ts = build_dp_train_step(
+            make_loss_fn(self.spec, cfg.label_smoothing), optimizer, comp,
+            plan, self.mesh,
+            num_microbatches=cfg.nsteps_update,
+            clip_norm=cfg.clip_norm,
+            fold_lr=self.schedule if cfg.fold_lr else None,
+        )
+        self.state = self.ts.init_state(params, state_rng,
+                                        model_state=model_state)
+        self.is_dense_only = comp.name == "none"
+
+        # ---- eval step: shard_map'd sum-reduce over dp ----
+        eval_fn = make_eval_fn(self.spec)
+        axes = tuple(self.mesh.axis_names)
+
+        def eval_step(params, mstate, batch):
+            sums = eval_fn(params, mstate, batch)
+            return jax.tree.map(
+                lambda x: jax.lax.psum(x, axes), sums)
+
+        self.eval_step = jax.jit(jax.shard_map(
+            eval_step, mesh=self.mesh,
+            in_specs=(P(), P(), P(axes)), out_specs=P(),
+            check_vma=False))
+
+        # ---- resume ----
+        if cfg.resume:
+            path = (cfg.resume if os.path.basename(cfg.resume).startswith(
+                "step_") else latest_checkpoint(cfg.resume))
+            if path:
+                self.state = restore_checkpoint(path, self.state, self.mesh)
+                self.logger.info("resumed from %s (step %d)", path,
+                                 int(self.state.step))
+
+        self.logger.info(
+            "model=%s dataset=%s params=%.2fM workers=%d global_bs=%d "
+            "compressor=%s density=%g buckets=%d k_total=%d "
+            "steps/epoch=%d total_steps=%d",
+            cfg.dnn, cfg.dataset, n_params / 1e6, self.nworkers,
+            local_bs, comp.name, cfg.density, len(plan.buckets),
+            plan.total_k, self.steps_per_epoch, self.total_steps)
+        self.jsonl.write({"event": "config", **{
+            k: getattr(cfg, k) for k in ("dnn", "dataset", "batch_size",
+                                         "compressor", "density", "lr")},
+            "nworkers": self.nworkers, "n_params": n_params,
+            "total_steps": self.total_steps})
+
+    # ------------------------------------------------------------------
+    def _dummy_inputs(self):
+        shape = (2,) + self.spec.input_shape
+        if self.spec.task == "seq2seq":
+            return (jnp.ones(shape, jnp.int32), jnp.ones(shape, jnp.int32))
+        return (jnp.zeros(shape, self.spec.input_dtype),)
+
+    @property
+    def step(self) -> int:
+        return int(jax.device_get(self.state.step))
+
+    @property
+    def epoch(self) -> int:
+        return self.step // self.steps_per_epoch
+
+    def _in_warmup(self, step: int) -> bool:
+        return self.is_dense_only or step < self.cfg.compress_warmup_steps
+
+    # ------------------------------------------------------------------
+    def train(self, num_iters: int, data_iter=None) -> Dict[str, float]:
+        """Run ``num_iters`` optimizer steps (reference ``trainer.train(n)``,
+        SURVEY.md §1.1 L4->L3 interface). Returns mean metrics."""
+        cfg = self.cfg
+        it = data_iter if data_iter is not None else self._train_iter()
+        losses, last = [], {}
+        for _ in range(num_iters):
+            # jax.profiler trace window (SURVEY.md §5 Tracing rebuild note:
+            # real fwd/bwd/comm breakdown comes from device traces, not
+            # host timers). cfg.profile_steps = (start, stop).
+            if cfg.profile_steps:
+                s = self.step
+                if s == cfg.profile_steps[0]:
+                    jax.profiler.start_trace(
+                        os.path.join(self.run_dir, "profile"))
+                    self._profiling = True
+                elif s >= cfg.profile_steps[1] and getattr(
+                        self, "_profiling", False):
+                    jax.profiler.stop_trace()
+                    self._profiling = False
+                    self.logger.info("profiler trace -> %s",
+                                     os.path.join(self.run_dir, "profile"))
+            self.timers.start("io")
+            batch = next(it)
+            batch = shard_batch(self.mesh, batch)
+            self.timers.start("step")
+            step = self.step if not hasattr(self, "_step_cache") else \
+                self._step_cache
+            fn = (self.ts.dense_step if self._in_warmup(step)
+                  else self.ts.sparse_step)
+            self.state, m = fn(self.state, batch)
+            # jit dispatch is async: sync before stopping the timer so
+            # step_s/ex-s measure device work, not dispatch latency
+            jax.block_until_ready(m.loss)
+            self._step_cache = step + 1
+            self.timers.stop()
+            losses.append(m)
+            if (step + 1) % cfg.log_every == 0:
+                last = self._log_train(step + 1, m)
+        if losses and not last:
+            last = self._log_train(self.step, losses[-1], quiet=True)
+        return last
+
+    def _train_iter(self):
+        if not hasattr(self, "_iter"):
+            self._iter = iter(data_lib.prefetch(self._stream(), depth=2))
+        return self._iter
+
+    def _stream(self):
+        """Epoch stream aligned to the current step — a resumed run
+        continues with the SAME epoch shuffle order and position an
+        uninterrupted run would see (exact data-iterator resume,
+        SURVEY.md §5 checkpoint rebuild note)."""
+        ep = self.step // self.steps_per_epoch
+        skip = self.step % self.steps_per_epoch
+        while True:
+            # every pipeline class (ArrayDataset, CifarPipeline, PTBDataset)
+            # accepts epoch_seed, so resume realignment is uniform
+            it = self.train_ds.epoch(epoch_seed=self.cfg.seed + ep)
+            for i, b in enumerate(it):
+                if skip and i < skip:
+                    continue
+                yield b
+            skip = 0
+            ep += 1
+
+    def _log_train(self, step: int, m, quiet: bool = False):
+        loss = float(jax.device_get(m.loss))
+        means = self.timers.means()
+        lr = float(self.schedule(step))
+        rec = {
+            "event": "train", "step": step, "epoch": self.epoch,
+            "loss": loss, "lr": lr,
+            "grad_norm": float(jax.device_get(m.grad_norm)),
+            "num_selected": float(jax.device_get(m.num_selected)),
+            "bytes_sent": int(jax.device_get(m.bytes_sent)),
+            "density": self.cfg.density,
+            "io_s": means.get("io", 0.0), "step_s": means.get("step", 0.0),
+        }
+        aux = jax.device_get(m.aux)
+        rec.update({k: float(v) for k, v in aux.items()})
+        self.jsonl.write(rec)
+        if not quiet:
+            imgs = self.cfg.global_batch_size / max(rec["step_s"], 1e-9)
+            self.logger.info(
+                "step %d (ep %d) loss=%.4f lr=%.4g io=%.1fms step=%.1fms "
+                "(%.0f ex/s) sent=%dB %s", step, self.epoch, loss, lr,
+                1e3 * rec["io_s"], 1e3 * rec["step_s"], imgs,
+                rec["bytes_sent"],
+                " ".join(f"{k}={float(v):.4f}" for k, v in aux.items()))
+        self.timers.reset()
+        return rec
+
+    # ------------------------------------------------------------------
+    def test(self, epoch: Optional[int] = None) -> Dict[str, float]:
+        """Full eval pass (reference ``trainer.test(epoch)``)."""
+        totals: Dict[str, float] = {}
+        for batch in self.test_ds.epoch():
+            batch = shard_batch(self.mesh, batch)
+            sums = jax.device_get(self.eval_step(
+                self.state.params, self.state.model_state, batch))
+            for k, v in sums.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+        n = max(totals.get("n", 1.0), 1.0)
+        out = {"val_loss": totals.get("loss_sum", 0.0) / n}
+        if "top1" in totals:
+            out["top1"] = totals["top1"] / n
+        if "top5" in totals:
+            out["top5"] = totals["top5"] / n
+        if self.spec.task == "lm":
+            out["perplexity"] = math.exp(min(out["val_loss"], 30.0))
+        rec = {"event": "eval", "step": self.step,
+               "epoch": epoch if epoch is not None else self.epoch, **out}
+        self.jsonl.write(rec)
+        self.logger.info("eval %s", " ".join(
+            f"{k}={v:.4f}" for k, v in out.items()))
+        return out
+
+    # ------------------------------------------------------------------
+    def fit(self) -> Dict[str, float]:
+        """The reference's outer epoch loop (SURVEY.md §3.1)."""
+        cfg = self.cfg
+        result: Dict[str, float] = {}
+        ckpt_dir = os.path.join(self.run_dir, "ckpt")
+        while self.step < self.total_steps:
+            n = min(self.steps_per_epoch, self.total_steps - self.step)
+            self.train(n)
+            ep = self.epoch
+            if cfg.eval_every_epochs and ep % cfg.eval_every_epochs == 0:
+                result = self.test(ep)
+            if cfg.save_every_epochs and ep % cfg.save_every_epochs == 0:
+                path = save_checkpoint(ckpt_dir, self.state)
+                self.logger.info("checkpoint -> %s", path)
+        save_checkpoint(ckpt_dir, self.state)
+        return result
+
+    def close(self):
+        self.jsonl.close()

@@ -1,0 +1,121 @@
+// Native host-side data pipeline for gaussiank_sgd_tpu.
+//
+// Role (SURVEY.md §2.1, §3.2): the reference leans on torch DataLoader's
+// C++ worker pool to keep accelerators fed; this library is the TPU
+// rebuild's native equivalent — batch assembly (index gather + u8->f32
+// normalization + pad-4 reflect random-crop + horizontal flip) in one
+// multi-threaded pass over the selected records, called from Python via
+// ctypes with the GIL released. A pure-numpy fallback with identical
+// semantics lives in data/cifar.py; tests compare the two paths.
+//
+// Determinism: per-image counter-based RNG (splitmix64 of seed ^ index),
+// so a batch is reproducible regardless of thread count or schedule.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+// reflect-pad coordinate into [0, n) for pad offsets in [-p, n-1+p]
+inline int reflect(int v, int n) {
+  if (v < 0) return -v;            // reflect without repeating the edge
+  if (v >= n) return 2 * n - 2 - v;
+  return v;
+}
+
+struct Job {
+  const uint8_t* images;   // [N, H, W, C] u8
+  const int32_t* labels;   // [N]
+  const int32_t* sel;      // [B] indices into N
+  int b, h, w, c, pad;
+  const float* mean;       // [C]
+  const float* stddev;     // [C]
+  float* out_x;            // [B, H, W, C] f32
+  int32_t* out_y;          // [B]
+  uint64_t seed;
+  bool augment;
+};
+
+void assemble_range(const Job& j, int lo, int hi) {
+  const int hw = j.h * j.w * j.c;
+  std::vector<float> inv(j.c);
+  for (int ch = 0; ch < j.c; ++ch) inv[ch] = 1.0f / j.stddev[ch];
+  for (int i = lo; i < hi; ++i) {
+    const uint8_t* src = j.images + static_cast<int64_t>(j.sel[i]) * hw;
+    float* dst = j.out_x + static_cast<int64_t>(i) * hw;
+    j.out_y[i] = j.labels[j.sel[i]];
+    int oy = 0, ox = 0;
+    bool flip = false;
+    if (j.augment) {
+      uint64_t r = splitmix64(j.seed ^ (0x9e3779b97f4a7c15ULL * (i + 1)));
+      oy = static_cast<int>(r % (2 * j.pad + 1)) - j.pad;
+      ox = static_cast<int>((r >> 16) % (2 * j.pad + 1)) - j.pad;
+      flip = ((r >> 32) & 1) != 0;
+    }
+    for (int y = 0; y < j.h; ++y) {
+      const int sy = reflect(y + oy, j.h);
+      for (int x = 0; x < j.w; ++x) {
+        int sx = reflect(x + ox, j.w);
+        if (flip) sx = j.w - 1 - sx;
+        const uint8_t* p = src + (sy * j.w + sx) * j.c;
+        float* q = dst + (y * j.w + x) * j.c;
+        for (int ch = 0; ch < j.c; ++ch) {
+          q[ch] = (static_cast<float>(p[ch]) * (1.0f / 255.0f) -
+                   j.mean[ch]) * inv[ch];
+        }
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Assemble a training batch: gather `sel`, normalize, optionally augment.
+// All buffers are caller-owned. Thread-parallel over the batch.
+void gk_assemble_batch(const uint8_t* images, const int32_t* labels,
+                       const int32_t* sel, int b, int h, int w, int c,
+                       int pad, const float* mean, const float* stddev,
+                       float* out_x, int32_t* out_y, uint64_t seed,
+                       int augment, int nthreads) {
+  Job j{images, labels, sel, b, h, w, c, pad, mean, stddev,
+        out_x, out_y, seed, augment != 0};
+  if (nthreads <= 1 || b < 2 * nthreads) {
+    assemble_range(j, 0, b);
+    return;
+  }
+  std::vector<std::thread> ts;
+  const int chunk = (b + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    const int lo = t * chunk;
+    const int hi = lo + chunk < b ? lo + chunk : b;
+    if (lo >= hi) break;
+    ts.emplace_back([&j, lo, hi] { assemble_range(j, lo, hi); });
+  }
+  for (auto& t : ts) t.join();
+}
+
+// Fisher-Yates shuffle of [0, n) with splitmix64 — the epoch permutation.
+void gk_shuffle_indices(int32_t* idx, int n, uint64_t seed) {
+  for (int i = 0; i < n; ++i) idx[i] = i;
+  uint64_t s = seed;
+  for (int i = n - 1; i > 0; --i) {
+    s = splitmix64(s);
+    const int k = static_cast<int>(s % static_cast<uint64_t>(i + 1));
+    const int32_t tmp = idx[i];
+    idx[i] = idx[k];
+    idx[k] = tmp;
+  }
+}
+
+}  // extern "C"

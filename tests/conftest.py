@@ -21,6 +21,7 @@ import jax  # noqa: E402
 # before the factories are dropped below.
 import chex  # noqa: E402, F401
 import optax  # noqa: E402, F401
+import jax.experimental.pallas  # noqa: E402, F401  (tpu_custom_call lowering)
 import jax._src.xla_bridge as _xb  # noqa: E402
 
 # The environment's sitecustomize registers an 'axon' backend factory that

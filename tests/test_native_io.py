@@ -1,0 +1,94 @@
+"""Native C++ host-pipeline tests (native/io_pipeline.cpp via ctypes).
+
+Skipped cleanly when the toolchain can't build the library; on this image
+g++ is baked in so they run in CI (SURVEY.md §2.1 native-layer parity).
+"""
+
+import numpy as np
+import pytest
+
+from gaussiank_sgd_tpu.data import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native lib unavailable")
+
+MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+
+def _data(n=64, h=32, w=32, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 256, size=(n, h, w, c), dtype=np.uint8)
+    y = rng.integers(0, 10, size=n).astype(np.int32)
+    return x, y
+
+
+def test_assemble_no_augment_matches_numpy():
+    x, y = _data()
+    sel = np.asarray([3, 1, 60, 7], np.int32)
+    out_x, out_y = native.assemble_batch(x, y, sel, MEAN, STD, seed=1,
+                                         augment=False)
+    want = (x[sel].astype(np.float32) / 255.0 - MEAN) / STD
+    # native multiplies by reciprocals (1/255, 1/std): identical math up to
+    # one ulp per op, amplified near zero by the mean subtraction
+    np.testing.assert_allclose(out_x, want, rtol=1e-3, atol=2e-3)
+    np.testing.assert_array_equal(out_y, y[sel])
+
+
+def test_assemble_augment_deterministic_and_label_safe():
+    x, y = _data()
+    sel = np.arange(32, dtype=np.int32)
+    a1 = native.assemble_batch(x, y, sel, MEAN, STD, seed=99, augment=True)
+    a2 = native.assemble_batch(x, y, sel, MEAN, STD, seed=99, augment=True)
+    b = native.assemble_batch(x, y, sel, MEAN, STD, seed=100, augment=True)
+    np.testing.assert_array_equal(a1[0], a2[0])       # same seed -> identical
+    assert not np.allclose(a1[0], b[0])               # different seed differs
+    np.testing.assert_array_equal(a1[1], y[sel])      # labels untouched
+    # augmented pixels are a permutation-ish of source rows: channel means
+    # stay close to the unaugmented normalization
+    plain = (x[sel].astype(np.float32) / 255.0 - MEAN) / STD
+    np.testing.assert_allclose(a1[0].mean(), plain.mean(), atol=0.05)
+
+
+def test_assemble_multithreaded_matches_single():
+    x, y = _data(256)
+    sel = np.arange(256, dtype=np.int32)
+    a = native.assemble_batch(x, y, sel, MEAN, STD, seed=5, augment=True,
+                              nthreads=1)
+    b = native.assemble_batch(x, y, sel, MEAN, STD, seed=5, augment=True,
+                              nthreads=8)
+    np.testing.assert_array_equal(a[0], b[0])  # counter-based RNG: schedule-
+    np.testing.assert_array_equal(a[1], b[1])  # independent determinism
+
+
+def test_shuffle_indices_is_permutation():
+    idx = native.shuffle_indices(1000, seed=7)
+    assert sorted(idx.tolist()) == list(range(1000))
+    idx2 = native.shuffle_indices(1000, seed=7)
+    np.testing.assert_array_equal(idx, idx2)
+    idx3 = native.shuffle_indices(1000, seed=8)
+    assert not np.array_equal(idx, idx3)
+
+
+def test_cifar_pipeline_native_end_to_end(tmp_path):
+    """Write a real cifar-10 binary batch file; pipeline must read+serve."""
+    rng = np.random.default_rng(0)
+    n = 128
+    recs = np.empty((n, 3073), np.uint8)
+    recs[:, 0] = rng.integers(0, 10, n)
+    recs[:, 1:] = rng.integers(0, 256, (n, 3072))
+    d = tmp_path / "cifar-10-batches-bin"
+    d.mkdir()
+    for i in range(1, 6):
+        recs.tofile(str(d / f"data_batch_{i}.bin"))
+    recs.tofile(str(d / "test_batch.bin"))
+
+    from gaussiank_sgd_tpu.data.cifar import CifarPipeline, make_cifar
+    ds, nc = make_cifar("cifar10", str(tmp_path), train=True, batch_size=64)
+    assert isinstance(ds, CifarPipeline)
+    assert nc == 10 and ds.num_examples == 5 * n
+    bx, by = next(iter(ds))
+    assert bx.shape == (64, 32, 32, 3) and bx.dtype == np.float32
+    assert by.shape == (64,) and 0 <= by.min() and by.max() < 10
+    # one epoch yields steps_per_epoch distinct batches
+    assert len(list(ds.epoch(epoch_seed=1))) == ds.steps_per_epoch

@@ -1,0 +1,92 @@
+"""Pallas threshold-select kernel tests (SURVEY.md §7 stage 6) — interpret
+mode on the CPU platform; the same code path lowers to Mosaic on real TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gaussiank_sgd_tpu.compressors import (decompress, get_compressor, k_for)
+from gaussiank_sgd_tpu.ops import (fused_stats, multi_threshold_counts,
+                                   pallas_gaussian_compress,
+                                   pallas_threshold_estimate)
+
+
+def _grad(n=300_000, dist="normal", seed=0, scale=0.01):
+    key = jax.random.PRNGKey(seed)
+    if dist == "normal":
+        return jax.random.normal(key, (n,)) * scale
+    return jax.random.laplace(key, (n,)) * scale
+
+
+def test_fused_stats_matches_numpy():
+    g = _grad(100_001)  # deliberately not a multiple of the chunk size
+    s, ss, amax = fused_stats(g)
+    np.testing.assert_allclose(float(s), float(jnp.sum(g)), rtol=1e-4)
+    np.testing.assert_allclose(float(ss), float(jnp.sum(g * g)), rtol=1e-4)
+    np.testing.assert_allclose(float(amax), float(jnp.max(jnp.abs(g))),
+                               rtol=1e-6)
+
+
+def test_multi_threshold_counts_matches_oracle():
+    g = _grad(50_000)
+    ts = jnp.linspace(0.0, 0.05, 32)
+    counts = multi_threshold_counts(g, ts)
+    a = np.abs(np.asarray(g))
+    oracle = np.array([(a > t).sum() for t in np.asarray(ts)])
+    np.testing.assert_array_equal(np.asarray(counts).astype(int), oracle)
+
+
+@pytest.mark.parametrize("dist", ["normal", "laplace"])
+@pytest.mark.parametrize("density", [0.001, 0.01, 0.1])
+def test_threshold_count_accuracy(dist, density):
+    """Selected count within 5% of k — the reference's bisection tolerance."""
+    g = _grad(dist=dist)
+    k = k_for(g.size, density)
+    t = pallas_threshold_estimate(g, k)
+    cnt = int(jnp.sum(jnp.abs(g) > t))
+    assert abs(cnt - k) <= max(0.05 * k, 3), (cnt, k)
+
+
+def test_pallas_compress_ef_invariant_and_registry():
+    g = _grad(100_000)
+    k = k_for(g.size, 0.01)
+    spec = get_compressor("gaussian_pallas", density=0.01)
+    out = spec.fn(g, k)
+    sent = decompress(out.compressed, g.size)
+    np.testing.assert_allclose(np.asarray(sent + out.residual),
+                               np.asarray(g), atol=1e-7)
+    assert out.compressed.indices.shape == (k,)
+
+
+def test_pallas_vs_xla_gaussian_overlap():
+    """Both estimators select nearly the same top-magnitude support."""
+    g = _grad(200_000)
+    k = k_for(g.size, 0.01)
+    a = pallas_gaussian_compress(g, k)
+    b = get_compressor("gaussian", density=0.01).fn(g, k)
+    ai = set(np.asarray(a.compressed.indices)[
+        np.asarray(a.compressed.values) != 0].tolist())
+    bi = set(np.asarray(b.compressed.indices)[
+        np.asarray(b.compressed.values) != 0].tolist())
+    overlap = len(ai & bi) / max(len(ai | bi), 1)
+    assert overlap > 0.9, overlap
+
+
+def test_edge_cases():
+    assert float(pallas_threshold_estimate(jnp.zeros(4096), 10)) == 0.0
+    t = pallas_threshold_estimate(jnp.ones(4096), 41)
+    cnt = int(jnp.sum(jnp.abs(jnp.ones(4096)) > t))
+    # constant tensor: any threshold selects all-or-nothing; packing still
+    # yields exactly k entries with the EF residual keeping the rest
+    out = pallas_gaussian_compress(jnp.ones(4096), 41)
+    sent = decompress(out.compressed, 4096)
+    np.testing.assert_allclose(np.asarray(sent + out.residual),
+                               np.ones(4096), atol=1e-7)
+
+
+def test_jit_compatible():
+    g = _grad(65_536)
+    f = jax.jit(lambda x: pallas_threshold_estimate(x, 655))
+    t1, t2 = f(g), f(g * 2.0)
+    assert float(t2) == pytest.approx(2 * float(t1), rel=1e-3)

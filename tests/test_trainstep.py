@@ -32,11 +32,12 @@ def make_problem(din=16, dout=4, width=32, seed=0):
     }
     w_true = jax.random.normal(kw, (din, dout))
 
-    def loss_fn(p, batch, rng):
+    def loss_fn(p, mstate, batch, rng):
         x, y = batch
         h = jnp.tanh(x @ p["w1"] + p["b1"])
         pred = h @ p["w2"] + p["b2"]
-        return jnp.mean((pred - y) ** 2), {"mse": jnp.mean((pred - y) ** 2)}
+        mse = jnp.mean((pred - y) ** 2)
+        return mse, (mstate, {"mse": mse})
 
     def make_batch(n, seed=1):
         kx2 = jax.random.PRNGKey(seed)
