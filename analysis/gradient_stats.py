@@ -50,20 +50,14 @@ def main(argv=None):
     p.add_argument("--density", type=float, default=0.001)
     args = p.parse_args(argv)
 
-    # CPU-mesh platform setup (same recipe as tests/conftest.py)
+    # CPU-mesh platform setup — shared recipe (gaussiank_sgd_tpu.virtual_cpu)
     import os
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    import jax
-    import chex, optax  # noqa: F401  (platform registration order)
-    import jax.experimental.pallas  # noqa: F401
-    import jax._src.xla_bridge as xb
-    for plat in ("axon", "tpu"):
-        xb._backend_factories.pop(plat, None)
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from gaussiank_sgd_tpu import virtual_cpu
+    virtual_cpu.provision(8)
 
+    import jax
     import jax.numpy as jnp
     from jax.flatten_util import ravel_pytree
     from gaussiank_sgd_tpu import data as data_lib, models as models_lib

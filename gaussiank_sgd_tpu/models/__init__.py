@@ -40,27 +40,30 @@ def get_model(dnn: str, dataset: Optional[str] = None, *,
               num_classes: Optional[int] = None,
               dtype=jnp.float32, **kw) -> ModelSpec:
     dnn = dnn.lower()
+    # **kw forwards to every module ctor (e.g. width/dropout overrides via
+    # TrainConfig.model_kwargs) — never silently dropped
     if dnn.startswith("resnet") and dnn != "resnet50":
         depth = int(dnn[len("resnet"):])
         nc = num_classes or (100 if dataset == "cifar100" else 10)
-        return ModelSpec(dnn, CifarResNet(depth=depth, num_classes=nc,
-                                          dtype=dtype),
+        kw.setdefault("depth", depth)
+        return ModelSpec(dnn, CifarResNet(num_classes=nc, dtype=dtype, **kw),
                          _CIFAR, jnp.float32, nc, "classify")
     if dnn == "resnet50":
         nc = num_classes or 1000
-        return ModelSpec(dnn, ResNet50(num_classes=nc, dtype=dtype),
+        return ModelSpec(dnn, ResNet50(num_classes=nc, dtype=dtype, **kw),
                          _IMAGENET, jnp.float32, nc, "classify")
     if dnn == "vgg16":
         nc = num_classes or 10
-        return ModelSpec(dnn, VGG16(num_classes=nc, dtype=dtype),
+        return ModelSpec(dnn, VGG16(num_classes=nc, dtype=dtype, **kw),
                          _CIFAR, jnp.float32, nc, "classify")
     if dnn == "alexnet":
         nc = num_classes or 10
-        return ModelSpec(dnn, AlexNet(num_classes=nc, dtype=dtype),
+        return ModelSpec(dnn, AlexNet(num_classes=nc, dtype=dtype, **kw),
                          _CIFAR, jnp.float32, nc, "classify")
     if dnn in ("mnistnet", "mnist"):
         nc = num_classes or 10
-        return ModelSpec("mnistnet", MnistNet(num_classes=nc, dtype=dtype),
+        return ModelSpec("mnistnet", MnistNet(num_classes=nc, dtype=dtype,
+                                              **kw),
                          _MNIST, jnp.float32, nc, "classify")
     if dnn == "lstm":  # PTB language model (SURVEY.md §2 C8)
         vocab = kw.pop("vocab_size", 10000)

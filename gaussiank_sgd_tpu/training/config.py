@@ -57,6 +57,13 @@ class TrainConfig:
     compute_dtype: str = "bfloat16"         # MXU-native compute
     seed: int = 42
 
+    # escape hatches for tests/experiments: extra ctor kwargs threaded
+    # through to models.get_model / data.make_dataset (e.g. a toy LSTM:
+    # model_kwargs={'hidden_dim': 64}, dataset_kwargs={'vocab_size': 256})
+    model_kwargs: dict = field(default_factory=dict)
+    dataset_kwargs: dict = field(default_factory=dict)
+    eval_max_batches: Optional[int] = None  # cap test() batches (None = all)
+
     # io / logging / checkpoints (reference settings.py + torch.save path)
     run_id: str = "run"
     output_dir: str = "./runs"

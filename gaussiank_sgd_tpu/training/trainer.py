@@ -70,21 +70,27 @@ class Trainer:
         # ---- data first (its cardinality sizes the model head/vocab) ----
         dtype = _dtype_of(cfg.compute_dtype)
         local_bs = cfg.batch_size * self.nworkers * cfg.nsteps_update
-        self.train_ds, card = data_lib.make_dataset(
-            cfg.dataset, cfg.data_dir, train=True, batch_size=local_bs)
         eval_bs = max(self.nworkers, local_bs // cfg.nsteps_update)
+        train_kw = dict(train=True, batch_size=local_bs)
+        test_kw = dict(train=False, batch_size=eval_bs)
+        train_kw.update(cfg.dataset_kwargs)   # overrides win, never collide
+        test_kw.update(cfg.dataset_kwargs)
+        self.train_ds, card = data_lib.make_dataset(
+            cfg.dataset, cfg.data_dir, **train_kw)
         self.test_ds, _ = data_lib.make_dataset(
-            cfg.dataset, cfg.data_dir, train=False, batch_size=eval_bs)
+            cfg.dataset, cfg.data_dir, **test_kw)
 
-        # ---- model: head size = explicit flag > dataset cardinality ----
-        model_kw = {}
+        # ---- model: head size = explicit flag > dataset cardinality;
+        # cfg.model_kwargs overrides EVERYTHING (single merged dict, so a
+        # key like num_classes/dtype overrides instead of raising a
+        # duplicate-keyword TypeError) ----
+        model_kw = {"num_classes": cfg.num_classes or card, "dtype": dtype}
         if cfg.dnn.lower() in ("lstm", "transformer"):
             model_kw["vocab_size"] = cfg.num_classes or card
         elif cfg.dnn.lower() == "lstman4":
             model_kw["num_labels"] = cfg.num_classes or card
-        self.spec = models_lib.get_model(
-            cfg.dnn, cfg.dataset, num_classes=cfg.num_classes or card,
-            dtype=dtype, **model_kw)
+        model_kw.update(cfg.model_kwargs)
+        self.spec = models_lib.get_model(cfg.dnn, cfg.dataset, **model_kw)
         self.steps_per_epoch = self.train_ds.steps_per_epoch
         self.total_steps = (cfg.max_steps if cfg.max_steps
                             else cfg.epochs * self.steps_per_epoch)
@@ -282,7 +288,10 @@ class Trainer:
     def test(self, epoch: Optional[int] = None) -> Dict[str, float]:
         """Full eval pass (reference ``trainer.test(epoch)``)."""
         totals: Dict[str, float] = {}
-        for batch in self.test_ds.epoch():
+        for i, batch in enumerate(self.test_ds.epoch()):
+            if (self.cfg.eval_max_batches is not None
+                    and i >= self.cfg.eval_max_batches):
+                break
             batch = shard_batch(self.mesh, batch)
             sums = jax.device_get(self.eval_step(
                 self.state.params, self.state.model_state, batch))

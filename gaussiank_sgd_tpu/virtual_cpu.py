@@ -1,0 +1,60 @@
+"""Virtual multi-device CPU platform provisioning — the ONE copy.
+
+SURVEY.md §4 "Multi-node without a cluster": every distributed code path in
+this framework is testable without hardware by forcing an n-device CPU
+platform (``--xla_force_host_platform_device_count``). The recipe has sharp
+edges (import ordering around the axon TPU-tunnel backend factory, jax
+private internals), so it lives here once and is shared by tests/conftest.py,
+__graft_entry__.dryrun_multichip, and the analysis scripts.
+
+This module deliberately imports nothing at module scope (so it can be
+imported before jax); ``provision(n)`` must be called before any jax
+operation executes (backend initialization), though importing jax first is
+harmless.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def provision(n_devices: int) -> None:
+    """Force an ``n_devices``-device CPU platform for this process.
+
+    Steps (order matters):
+      1. env vars, in case jax is not yet imported (earliest, most robust);
+      2. import chex / optax / pallas BEFORE dropping backend factories —
+         their import-time MLIR registrations require the 'tpu' platform to
+         still be known;
+      3. drop the remote backend factories ('axon' tunnel, 'tpu') so nothing
+         ever touches tunnel health;
+      4. jax.config updates, which win regardless of env-var timing.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    import jax
+    import chex  # noqa: F401
+    import optax  # noqa: F401
+    import jax.experimental.pallas  # noqa: F401
+    import jax._src.xla_bridge as xb
+
+    for name in ("axon", "tpu"):
+        xb._backend_factories.pop(name, None)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", int(n_devices))
+
+
+def enable_compile_cache(path: str | None = None) -> None:
+    """Persistent compilation cache (huge win for repeated test programs)."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        path or os.environ.get("GKSGD_TEST_CACHE", "/tmp/gksgd_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
