@@ -15,7 +15,7 @@ import functools
 from typing import Callable, NamedTuple, Optional
 
 from .base import CompressResult
-from .exact import none_compress, topk_compress
+from .exact import approx_topk_compress, none_compress, topk_compress
 from .gaussian import gaussiank_compress
 from .randomk import randomk_compress, randomkec_compress
 from .sampling import dgc_compress, redsync_compress, redsynctrim_compress
@@ -48,6 +48,10 @@ def get_compressor(name: str, *, density: float = 0.001,
         return CompressorSpec("none", none_compress, False, False, None)
     if name == "topk":
         return CompressorSpec("topk", topk_compress, False, True, lambda k: k)
+    if name in ("approxtopk", "approx_topk"):
+        # TPU-native flagship: hardware two-level select (see exact.py)
+        return CompressorSpec("approxtopk", approx_topk_compress, False, True,
+                              lambda k: k)
     if name in ("gaussian", "gaussiank"):
         fn = functools.partial(gaussiank_compress, density=density,
                                sigma_scale=sigma_scale)
@@ -77,5 +81,5 @@ def get_compressor(name: str, *, density: float = 0.001,
     raise ValueError(f"unknown compressor {name!r}; known: {sorted(NAMES)}")
 
 
-NAMES = ("none", "topk", "gaussian", "gaussian_pallas", "randomk",
-         "randomkec", "dgcsampling", "redsync", "redsynctrim")
+NAMES = ("none", "topk", "approxtopk", "gaussian", "gaussian_pallas",
+         "randomk", "randomkec", "dgcsampling", "redsync", "redsynctrim")

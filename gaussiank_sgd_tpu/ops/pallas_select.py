@@ -100,16 +100,21 @@ def fused_stats(flat: jax.Array, interpret: Optional[bool] = None
 
 
 def _count_kernel(x_ref, t_ref, counts_ref):
+    # t_ref/counts_ref live in SMEM; the candidate loop is a static unroll of
+    # NCAND vector compare+reduce ops over the VMEM block — Mosaic-friendly
+    # (no shape casts; a [chunk,1]x[1,NCAND] broadcast-compare reshape is an
+    # unsupported vector layout cast on TPU).
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _init():
-        counts_ref[:] = jnp.zeros_like(counts_ref)
+        for j in range(_NCAND):
+            counts_ref[0, j] = 0.0
 
-    ax = jnp.abs(x_ref[:]).reshape(-1, 1)          # [chunk, 1]
-    t = t_ref[:]                                   # [1, NCAND]
-    counts_ref[:] += jnp.sum((ax > t).astype(jnp.float32), axis=0,
-                             keepdims=True)
+    ax = jnp.abs(x_ref[:])                         # [rows, 128]
+    for j in range(_NCAND):
+        counts_ref[0, j] += jnp.sum(
+            (ax > t_ref[0, j]).astype(jnp.float32))
 
 
 def multi_threshold_counts(flat: jax.Array, thresholds: jax.Array,
@@ -127,8 +132,8 @@ def multi_threshold_counts(flat: jax.Array, thresholds: jax.Array,
         _count_kernel,
         grid=grid,
         in_specs=[_spec((rows, 128), lambda i: (i, 0)),
-                  _spec((1, _NCAND), lambda i: (0, 0))],
-        out_specs=_spec((1, _NCAND), lambda i: (0, 0)),
+                  _spec((1, _NCAND), lambda i: (0, 0), smem=True)],
+        out_specs=_spec((1, _NCAND), lambda i: (0, 0), smem=True),
         out_shape=jax.ShapeDtypeStruct((1, _NCAND), jnp.float32),
         interpret=interpret,
     )(x, t)

@@ -165,6 +165,10 @@ class DPTrainStep(NamedTuple):
     init_state: Callable[..., TrainState]
     plan: BucketPlan
     mesh: Mesh
+    # ('sparse'|'dense', n) -> jitted (state, batch) -> (state, last_metrics)
+    # running n steps in ONE device-side fori_loop — one dispatch for n
+    # steps, so benchmarks measure device work, not host/tunnel dispatch.
+    make_multi_step: Callable[[str, int], Callable]
 
 
 def build_dp_train_step(
@@ -325,14 +329,31 @@ def build_dp_train_step(
     state_spec = TrainState(step=P(), params=P(), model_state=P(),
                             opt_state=P(), ef_residual=P(axes), rng=P())
 
-    def _wrap(fn):
-        smapped = shard_map(
+    def _smap(fn):
+        return shard_map(
             fn, mesh=mesh,
             in_specs=(state_spec, batch_spec),
             out_specs=(state_spec, P()),
             check_vma=False,
         )
-        return jax.jit(smapped, donate_argnums=(0,))
+
+    def _wrap(fn):
+        return jax.jit(_smap(fn), donate_argnums=(0,))
+
+    def make_multi_step(kind: str, n: int):
+        """n chained steps in one jitted program (benchmark-grade timing)."""
+        smapped = _smap(sparse_step_fn if kind == "sparse" else dense_step_fn)
+
+        def run(state: TrainState, batch: Any):
+            state, metrics = smapped(state, batch)
+
+            def body(_, carry):
+                s, _m = carry
+                return smapped(s, batch)
+
+            return lax.fori_loop(1, n, body, (state, metrics))
+
+        return jax.jit(run, donate_argnums=(0,))
 
     def init_state(params: Any, rng: jax.Array,
                    model_state: Any = None) -> TrainState:
@@ -355,4 +376,4 @@ def build_dp_train_step(
         )
 
     return DPTrainStep(_wrap(sparse_step_fn), _wrap(dense_step_fn),
-                       init_state, plan, mesh)
+                       init_state, plan, mesh, make_multi_step)
