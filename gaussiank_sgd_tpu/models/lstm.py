@@ -7,14 +7,18 @@ the train step applies via ``clip_norm``.
 
 TPU note: the recurrence runs under ``nn.RNN`` (``lax.scan`` inside), so the
 whole unrolled window is one fused XLA while-loop — no per-timestep dispatch.
-The reference carries the hidden state across bptt windows ("repackaging");
-here each window starts from a learned-zero carry by default, and a carry can
-be threaded explicitly through ``initial_carry`` for exact parity.
+The reference carries the hidden state across bptt windows, detaching it
+("repackaging", SURVEY.md §3.2); here the carry is threaded explicitly:
+``initial_carry`` feeds the previous window's final state in, and
+``return_carry=True`` hands the new final state back out. The train step
+stores it in ``TrainState.carry`` (parallel/trainstep.py ``recurrent=True``)
+— no gradient flows into past windows, exactly the reference's truncated
+bptt semantics.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -28,17 +32,32 @@ class LSTMLM(nn.Module):
     dropout: float = 0.5
     dtype: Any = jnp.float32
 
+    def initial_carry(self, batch_size: int) -> Tuple:
+        """Zero carry for ``batch_size`` rows: ((c, h) per layer)."""
+        z = jnp.zeros((batch_size, self.hidden_dim), self.dtype)
+        return tuple((z, z) for _ in range(self.num_layers))
+
     @nn.compact
-    def __call__(self, tokens, train: bool = True, initial_carry=None):
+    def __call__(self, tokens, train: bool = True, initial_carry=None,
+                 return_carry: bool = False):
         # tokens: int32[B, T] -> logits float[B, T, V]
+        #                       (+ final carry when return_carry)
         x = nn.Embed(self.vocab_size, self.embed_dim,
                      dtype=self.dtype)(tokens)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        carries = []
         for i in range(self.num_layers):
             rnn = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim,
                                               dtype=self.dtype),
                          name=f"lstm_{i}")
             carry = None if initial_carry is None else initial_carry[i]
-            x = rnn(x, initial_carry=carry)
+            if return_carry:
+                carry, x = rnn(x, initial_carry=carry, return_carry=True)
+                carries.append(carry)
+            else:
+                x = rnn(x, initial_carry=carry)
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
-        return nn.Dense(self.vocab_size, dtype=jnp.float32)(x)
+        logits = nn.Dense(self.vocab_size, dtype=jnp.float32)(x)
+        if return_carry:
+            return logits, tuple(carries)
+        return logits

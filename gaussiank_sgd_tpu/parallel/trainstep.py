@@ -60,6 +60,12 @@ class TrainState(NamedTuple):
     opt_state: optax.OptState  # (replicated)
     ef_residual: jax.Array   # float32[num_devices, total_numel], sharded(dp)
     rng: jax.Array           # PRNG key (replicated)
+    carry: Any = ()          # recurrent hidden state carried across steps
+                             # (the reference's bptt "repackaging",
+                             # SURVEY.md §3.2). Leaves are [batch, ...] and
+                             # batch-dim sharded over dp — each worker owns
+                             # the carry for its own batch rows. () for
+                             # non-recurrent models.
 
 
 class StepMetrics(NamedTuple):
@@ -76,11 +82,20 @@ class StepMetrics(NamedTuple):
 #   -> (scalar loss, (new_model_state, aux pytree))
 # ``model_state`` carries non-trainable collections (BatchNorm running stats);
 # pure-param models pass/return an empty dict.
-LossFn = Callable[[Any, Any, Any, jax.Array], Tuple[jax.Array, Any]]
+#
+# Recurrent variant (``recurrent=True``):
+# loss_fn(params, model_state, batch, rng, carry)
+#   -> (scalar loss, (new_model_state, aux pytree, new_carry))
+# ``carry`` is the hidden state from the previous bptt window; the loss fn
+# consumes it as a constant (no gradient flows into past windows — the
+# reference's *detaching* "repackage", SURVEY.md §3.2) and returns the final
+# carry for the next window.
+LossFn = Callable[..., Tuple[jax.Array, Any]]
 
 
 def _microbatch_grads(loss_fn: LossFn, params: Any, model_state: Any,
-                      batch: Any, rng: jax.Array, num_microbatches: int):
+                      batch: Any, rng: jax.Array, num_microbatches: int,
+                      carry: Any = (), recurrent: bool = False):
     """Local grads, averaged over ``num_microbatches`` sequential microbatches.
 
     Reference parity: ``--nsteps-update`` gradient accumulation
@@ -88,34 +103,60 @@ def _microbatch_grads(loss_fn: LossFn, params: Any, model_state: Any,
     ``num_microbatches`` equal chunks and scanned — constant memory in the
     accumulation factor. ``model_state`` threads through the microbatches
     sequentially (last microbatch's stats win, like sequential torch steps).
+    ``carry`` splits along the batch dim like the batch itself (each
+    microbatch advances its own rows' hidden state) and the per-chunk final
+    carries reassemble into the full-batch carry.
     """
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def call(mstate, mb_i, rng_i, carry_i):
+        if recurrent:
+            (loss, (mstate, aux, c)), grads = grad_fn(params, mstate, mb_i,
+                                                      rng_i, carry_i)
+        else:
+            (loss, (mstate, aux)), grads = grad_fn(params, mstate, mb_i,
+                                                   rng_i)
+            c = ()
+        return loss, mstate, aux, c, grads
+
     if num_microbatches <= 1:
-        (loss, (mstate, aux)), grads = grad_fn(params, model_state, batch, rng)
-        return loss, mstate, aux, grads
+        return call(model_state, batch, rng, carry)
 
     def split(x):
         return x.reshape((num_microbatches, x.shape[0] // num_microbatches)
                          + x.shape[1:])
 
     mb = jax.tree.map(split, batch)
+    mb_carry = jax.tree.map(split, carry)
     rngs = jax.random.split(rng, num_microbatches)
 
-    def body(carry, mb_rng):
-        mb_i, rng_i = mb_rng
-        c_loss, c_mstate, c_aux, c_grads = carry
-        (loss, (mstate, aux)), grads = grad_fn(params, c_mstate, mb_i, rng_i)
+    def body(acc, inp):
+        mb_i, rng_i, carry_i = inp
+        c_loss, c_mstate, c_aux, c_grads = acc
+        loss, mstate, aux, c, grads = call(c_mstate, mb_i, rng_i, carry_i)
         return ((c_loss + loss, mstate, jax.tree.map(jnp.add, c_aux, aux),
-                 jax.tree.map(jnp.add, c_grads, grads)), None)
+                 jax.tree.map(jnp.add, c_grads, grads)), c)
 
-    (loss0, (mstate0, aux0)), grads0 = grad_fn(
-        params, model_state, jax.tree.map(lambda x: x[0], mb), rngs[0])
-    (loss, mstate, aux, grads), _ = lax.scan(
+    first = lambda x: jax.tree.map(lambda v: v[0], x)
+    rest = lambda x: jax.tree.map(lambda v: v[1:], x)
+    loss0, mstate0, aux0, carry0, grads0 = call(
+        model_state, first(mb), rngs[0], first(mb_carry))
+    (loss, mstate, aux, grads), carry_rest = lax.scan(
         body, (loss0, mstate0, aux0, grads0),
-        (jax.tree.map(lambda x: x[1:], mb), rngs[1:]))
+        (rest(mb), rngs[1:], rest(mb_carry)))
+    if recurrent:
+        # reassemble [num_mb, B/num_mb, ...] chunk carries -> [B, ...]
+        stacked = jax.tree.map(
+            lambda c0, cr: jnp.concatenate([c0[None], cr]), carry0,
+            carry_rest)
+        new_carry = jax.tree.map(
+            lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]),
+            stacked)
+    else:
+        new_carry = ()
     inv = 1.0 / num_microbatches
     return (loss * inv, mstate, jax.tree.map(lambda x: x * inv, aux),
-            jax.tree.map(lambda x: x * inv, grads))
+            new_carry, jax.tree.map(lambda x: x * inv, grads))
 
 
 def _clip_by_global_norm(flat_g: jax.Array, clip: Optional[float]):
@@ -183,6 +224,7 @@ def build_dp_train_step(
     fold_lr: Optional[Callable[[jax.Array], jax.Array]] = None,
     grad_dtype=jnp.float32,
     exchange: str = "allgather",
+    recurrent: bool = False,
 ) -> DPTrainStep:
     """Build the data-parallel train step over ``mesh``.
 
@@ -201,6 +243,11 @@ def build_dp_train_step(
     ``exchange``: ``'allgather'`` (the reference's C2 path / north-star) or
     ``'gtopk'`` (the reference's C3 gTop-k tree allreduce, rebuilt as a
     ppermute butterfly — parallel/gtopk.py; 1-D power-of-2 meshes only).
+
+    ``recurrent``: the loss fn follows the carry-threading protocol (see
+    LossFn) and ``TrainState.carry`` holds batch-dim-sharded hidden state
+    that persists across steps — the reference's bptt "repackaging"
+    (SURVEY.md §3.2). Pass the initial carry to ``init_state``.
     """
     axes = tuple(mesh.axis_names)
     if exchange == "gtopk":
@@ -246,32 +293,33 @@ def build_dp_train_step(
         return data_rng, comp_rng
 
     def _local_grads(state: TrainState, batch: Any, data_rng: jax.Array):
-        loss, mstate, aux, grads = _microbatch_grads(
+        loss, mstate, aux, new_carry, grads = _microbatch_grads(
             loss_fn, state.params, state.model_state, batch, data_rng,
-            num_microbatches)
+            num_microbatches, state.carry, recurrent)
         flat_g, unravel = ravel_pytree(grads)
         flat_g = _clip_by_global_norm(flat_g.astype(grad_dtype), clip_norm)
         # dp-mean of loss/aux/model-state for logging & replicated-stats
         # consistency (BatchNorm running stats are averaged across workers —
-        # strictly better than the reference's per-GPU local stats).
+        # strictly better than the reference's per-GPU local stats). The
+        # carry is NOT averaged: like the batch, it is per-worker data.
         def pmean_floats(x):
             return _pmean(x) if jnp.issubdtype(x.dtype, jnp.floating) else x
         mstate = jax.tree.map(pmean_floats, mstate)
-        return (_pmean(loss), mstate, jax.tree.map(_pmean, aux), flat_g,
-                unravel)
+        return (_pmean(loss), mstate, jax.tree.map(_pmean, aux), new_carry,
+                flat_g, unravel)
 
     def _apply(state: TrainState, mstate: Any, dense_flat: jax.Array, unravel,
-               new_residual: jax.Array):
+               new_residual: jax.Array, new_carry: Any):
         updates, opt_state = optimizer.update(
             unravel(dense_flat), state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(state.step + 1, params, mstate, opt_state,
-                          new_residual, state.rng)
+                          new_residual, state.rng, new_carry)
 
     def sparse_step_fn(state: TrainState, batch: Any):
         data_rng, comp_rng = _step_rngs(state)
-        loss, mstate, aux, flat_g, unravel = _local_grads(state, batch,
-                                                          data_rng)
+        loss, mstate, aux, new_carry, flat_g, unravel = _local_grads(
+            state, batch, data_rng)
         scale = fold_lr(state.step) if fold_lr is not None else 1.0
         acc = state.ef_residual[0] + scale * flat_g  # local residual row
         comp, residual, nsel = compress_buckets(spec, plan, acc, comp_rng)
@@ -302,15 +350,16 @@ def build_dp_train_step(
             bytes_sent = jnp.int32(
                 k_packed * (4 + comp.values.dtype.itemsize))
 
-        new_state = _apply(state, mstate, dense, unravel, residual[None, :])
+        new_state = _apply(state, mstate, dense, unravel, residual[None, :],
+                           new_carry)
         return new_state, StepMetrics(
             loss, aux, _pmean(jnp.linalg.norm(flat_g)),
             _pmean(nsel.astype(jnp.float32)), bytes_sent)
 
     def dense_step_fn(state: TrainState, batch: Any):
         data_rng, _ = _step_rngs(state)
-        loss, mstate, aux, flat_g, unravel = _local_grads(state, batch,
-                                                          data_rng)
+        loss, mstate, aux, new_carry, flat_g, unravel = _local_grads(
+            state, batch, data_rng)
         scale = fold_lr(state.step) if fold_lr is not None else 1.0
         dense = scale * flat_g
         for a in axes:
@@ -318,16 +367,19 @@ def build_dp_train_step(
         dense = dense / _all_axes_size()
         # Warm-up is compression-off: the EF residual is untouched (and zero
         # if warm-up precedes any sparse step), matching SURVEY.md §2.3.
-        new_state = _apply(state, mstate, dense, unravel, state.ef_residual)
+        new_state = _apply(state, mstate, dense, unravel, state.ef_residual,
+                           new_carry)
         return new_state, StepMetrics(
             loss, aux, _pmean(jnp.linalg.norm(flat_g)),
             jnp.float32(n_total), jnp.int32(n_total * 4))
 
     batch_spec = P(axes)            # leading dim sharded over every dp axis
     # Pytree-prefix specs: everything in TrainState is replicated except the
-    # per-worker ef_residual, which shards its leading [num_devices] dim.
+    # per-worker ef_residual (leading [num_devices] dim) and the recurrent
+    # carry (batch-dim sharded, like the batch itself).
     state_spec = TrainState(step=P(), params=P(), model_state=P(),
-                            opt_state=P(), ef_residual=P(axes), rng=P())
+                            opt_state=P(), ef_residual=P(axes), rng=P(),
+                            carry=P(axes) if recurrent else P())
 
     def _smap(fn):
         return shard_map(
@@ -356,10 +408,13 @@ def build_dp_train_step(
         return jax.jit(run, donate_argnums=(0,))
 
     def init_state(params: Any, rng: jax.Array,
-                   model_state: Any = None) -> TrainState:
+                   model_state: Any = None, carry: Any = ()) -> TrainState:
         flat, _ = ravel_pytree(params)
         assert flat.size == n_total, (
             f"bucket plan built for {n_total} params, model has {flat.size}")
+        if recurrent:
+            assert jax.tree_util.tree_leaves(carry), \
+                "recurrent=True needs an initial carry (model.initial_carry)"
         # The step functions donate their input state; copy so the caller's
         # param buffers are never invalidated (and two states can share an
         # init pytree).
@@ -373,6 +428,7 @@ def build_dp_train_step(
             opt_state=optimizer.init(params),
             ef_residual=jnp.zeros((mesh.size, n_total), grad_dtype),
             rng=rng,
+            carry=jax.tree.map(jnp.copy, carry),
         )
 
     return DPTrainStep(_wrap(sparse_step_fn), _wrap(dense_step_fn),

@@ -22,6 +22,20 @@ Examples (mirroring the reference's launch scripts, SURVEY.md §2 C12):
 from __future__ import annotations
 
 import argparse
+import os
+
+# Honor the virtual-CPU hook BEFORE any jax import side effect: with
+# GKSGD_FORCE_VIRTUAL_CPU=<n> the CLI runs on an n-device virtual CPU mesh
+# (multi-worker configs without hardware — SURVEY.md §4, scripts/run_all.sh).
+_vcpu = os.environ.get("GKSGD_FORCE_VIRTUAL_CPU", "")
+if _vcpu.strip():
+    if not _vcpu.strip().isdigit() or int(_vcpu) <= 0:
+        raise SystemExit(
+            f"GKSGD_FORCE_VIRTUAL_CPU must be a positive device count, "
+            f"got {_vcpu!r} (unset it to use the real backend)")
+    from . import virtual_cpu
+
+    virtual_cpu.provision(int(_vcpu))
 
 from .parallel.mesh import maybe_initialize_distributed
 from .training.config import add_args, from_args
@@ -35,7 +49,7 @@ def main(argv=None):
     add_args(p)
     args = p.parse_args(argv)
     maybe_initialize_distributed()
-    cfg = from_args(args)
+    cfg = from_args(args, argv)
     trainer = Trainer(cfg)
     try:
         result = trainer.fit()

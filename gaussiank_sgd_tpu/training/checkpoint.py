@@ -77,6 +77,7 @@ def restore_checkpoint(path: str, target: TrainState,
             opt_state=jax.tree.map(lambda x: sds(x, repl), target.opt_state),
             ef_residual=sds(target.ef_residual, dp),
             rng=sds(target.rng, repl),
+            carry=jax.tree.map(lambda x: sds(x, dp), target.carry),
         )
     else:
         abstract = jax.tree.map(sds, target)

@@ -44,6 +44,10 @@ class TrainConfig:
     warmup_epochs: float = 5.0              # LR warmup (multi-worker scaling)
     clip_norm: Optional[float] = None       # grad clipping (LSTM: 0.25)
     label_smoothing: float = 0.0            # transformer: 0.1
+    carry_hidden: bool = True               # LSTM: carry hidden state across
+                                            # bptt windows (the reference's
+                                            # "repackaging"); False = fresh
+                                            # zero carry per window
 
     # compression (reference --compressor/--density/--sigma-scale)
     compressor: str = "none"
@@ -81,8 +85,20 @@ class TrainConfig:
         return self.batch_size * max(1, self.nworkers) * self.nsteps_update
 
 
-def add_args(p: argparse.ArgumentParser) -> None:
-    """CLI flags named as in the reference entrypoint (SURVEY.md §2 C6)."""
+def add_args(p: argparse.ArgumentParser, suppress_defaults: bool = False) -> None:
+    """CLI flags named as in the reference entrypoint (SURVEY.md §2 C6).
+
+    ``suppress_defaults``: every flag defaults to ``argparse.SUPPRESS`` so a
+    parse reveals exactly which flags the user typed (used for --config file
+    precedence in from_args).
+    """
+    if suppress_defaults:
+        real_add = p.add_argument
+
+        def add_argument(*a, **kw):
+            kw["default"] = argparse.SUPPRESS
+            return real_add(*a, **kw)
+        p.add_argument = add_argument
     d = TrainConfig()
     p.add_argument("--dnn", default=d.dnn)
     p.add_argument("--dataset", default=d.dataset)
@@ -99,7 +115,8 @@ def add_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--weight-decay", dest="weight_decay", type=float,
                    default=d.weight_decay)
-    p.add_argument("--nesterov", action="store_true")
+    p.add_argument("--nesterov", action=argparse.BooleanOptionalAction,
+                   default=d.nesterov)
     p.add_argument("--epochs", type=int, default=d.epochs)
     p.add_argument("--max-steps", dest="max_steps", type=int, default=None)
     p.add_argument("--warmup-epochs", dest="warmup_epochs", type=float,
@@ -107,6 +124,12 @@ def add_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--clip-norm", dest="clip_norm", type=float, default=None)
     p.add_argument("--label-smoothing", dest="label_smoothing", type=float,
                    default=d.label_smoothing)
+    p.add_argument("--carry-hidden", dest="carry_hidden",
+                   action=argparse.BooleanOptionalAction,
+                   default=d.carry_hidden,
+                   help="LSTM: carry hidden state across bptt windows "
+                        "(reference repackaging); --no-carry-hidden = fresh "
+                        "zero carry per window")
     p.add_argument("--compressor", default=d.compressor,
                    help="none|topk|gaussian|randomk|randomkec|dgcsampling|"
                         "redsync|redsynctrim")
@@ -116,7 +139,8 @@ def add_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--bucket-size", dest="bucket_size", type=int, default=None)
     p.add_argument("--compress-warmup-steps", dest="compress_warmup_steps",
                    type=int, default=d.compress_warmup_steps)
-    p.add_argument("--fold-lr", dest="fold_lr", action="store_true")
+    p.add_argument("--fold-lr", dest="fold_lr",
+                   action=argparse.BooleanOptionalAction, default=d.fold_lr)
     p.add_argument("--compute-dtype", dest="compute_dtype",
                    default=d.compute_dtype)
     p.add_argument("--seed", type=int, default=d.seed)
@@ -127,8 +151,47 @@ def add_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--save-every-epochs", dest="save_every_epochs", type=int,
                    default=d.save_every_epochs)
     p.add_argument("--resume", default=None)
+    p.add_argument("--model-kwargs", dest="model_kwargs", type=json.loads,
+                   default={}, help='JSON, e.g. \'{"hidden_dim": 64}\'')
+    p.add_argument("--dataset-kwargs", dest="dataset_kwargs", type=json.loads,
+                   default={}, help='JSON, e.g. \'{"vocab_size": 256}\'')
+    p.add_argument("--eval-max-batches", dest="eval_max_batches", type=int,
+                   default=None)
+    p.add_argument("--config", dest="config", default=None,
+                   help="JSON config file (exp_configs/*.json); CLI flags "
+                        "explicitly given on the command line override it")
 
 
-def from_args(args: argparse.Namespace) -> TrainConfig:
+def from_args(args: argparse.Namespace,
+              argv: Optional[List[str]] = None) -> TrainConfig:
+    """Build a TrainConfig from parsed args, optionally layered on a JSON
+    config file (reference ``exp_configs`` role, SURVEY.md §2 C12).
+
+    Precedence: dataclass defaults < ``--config`` file < flags explicitly
+    present on the command line. Explicitness is detected by re-parsing
+    ``argv`` with all defaults suppressed, so passing a flag at its default
+    value still overrides the file.
+    """
     fields = {f.name for f in dataclasses.fields(TrainConfig)}
-    return TrainConfig(**{k: v for k, v in vars(args).items() if k in fields})
+    base = {k: v for k, v in vars(args).items() if k in fields}
+    cfg_path = getattr(args, "config", None)
+    if not cfg_path:
+        return TrainConfig(**base)
+    with open(cfg_path) as f:
+        file_vals = json.load(f)
+    # "_comment"-style annotation keys are documentation, not config
+    file_vals = {k: v for k, v in file_vals.items() if not k.startswith("_")}
+    unknown = set(file_vals) - fields
+    if unknown:
+        raise ValueError(f"unknown keys in {cfg_path}: {sorted(unknown)}")
+    # tuples arrive as JSON lists
+    for k, v in file_vals.items():
+        if isinstance(v, list):
+            file_vals[k] = tuple(v)
+    explicit_p = argparse.ArgumentParser()
+    add_args(explicit_p, suppress_defaults=True)
+    explicit, _ = explicit_p.parse_known_args(argv)
+    merged = dict(base)
+    merged.update(file_vals)
+    merged.update({k: v for k, v in vars(explicit).items() if k in fields})
+    return TrainConfig(**merged)

@@ -17,11 +17,11 @@ import optax
 from ..models import ModelSpec
 
 
-def _apply(spec: ModelSpec, params, mstate, rng, *inputs):
+def _apply(spec: ModelSpec, params, mstate, rng, *inputs, **extra):
     """Train-mode apply, threading mutable collections + dropout rng."""
     variables = {"params": params, **mstate}
     mutable = [k for k in mstate.keys()]
-    kwargs = dict(train=True, rngs={"dropout": rng})
+    kwargs = dict(train=True, rngs={"dropout": rng}, **extra)
     if mutable:
         out, updated = spec.module.apply(variables, *inputs,
                                          mutable=mutable, **kwargs)
@@ -29,8 +29,25 @@ def _apply(spec: ModelSpec, params, mstate, rng, *inputs):
     return spec.module.apply(variables, *inputs, **kwargs), mstate
 
 
-def make_loss_fn(spec: ModelSpec, label_smoothing: float = 0.0) -> Callable:
+def make_loss_fn(spec: ModelSpec, label_smoothing: float = 0.0,
+                 recurrent: bool = False) -> Callable:
+    """``recurrent=True`` (lm only): the carry-threading LossFn protocol of
+    parallel/trainstep.py — consume the previous window's hidden state,
+    return the new one (the reference's bptt repackaging, SURVEY.md §3.2)."""
     task = spec.task
+
+    if recurrent:
+        assert task == "lm", f"carry threading is for lm models, not {task}"
+
+        def loss_fn(params, mstate, batch, rng, carry):
+            x, y = batch
+            (logits, new_carry), mstate = _apply(
+                spec, params, mstate, rng, x,
+                initial_carry=carry, return_carry=True)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            return loss, (mstate, {"ce_per_token": loss}, new_carry)
+        return loss_fn
 
     if task == "classify":
         def loss_fn(params, mstate, batch, rng):
@@ -87,18 +104,36 @@ def make_loss_fn(spec: ModelSpec, label_smoothing: float = 0.0) -> Callable:
     raise ValueError(f"unknown task {task!r}")
 
 
-def make_eval_fn(spec: ModelSpec) -> Callable:
+def make_eval_fn(spec: ModelSpec, recurrent: bool = False) -> Callable:
     """(params, mstate, batch) -> dict of SUMS (caller psums + normalizes).
 
     Eval-mode apply (train=False, running BatchNorm stats, no dropout).
     Returns sums so distributed eval just adds across shards — top-1/top-5/
     val-loss/perplexity exactly as the reference's test loop (SURVEY.md §2 C5).
+
+    ``recurrent=True`` (lm only): signature becomes
+    ``(params, mstate, batch, carry) -> (sums, new_carry)`` so the eval loop
+    threads hidden state across the contiguous bptt windows of the test
+    stream — the reference evaluates perplexity with carried state too.
     """
     task = spec.task
 
-    def apply_eval(params, mstate, *inputs):
+    def apply_eval(params, mstate, *inputs, **extra):
         return spec.module.apply({"params": params, **mstate}, *inputs,
-                                 train=False)
+                                 train=False, **extra)
+
+    if recurrent:
+        assert task == "lm", f"carry threading is for lm models, not {task}"
+
+        def eval_fn(params, mstate, batch, carry):
+            x, y = batch
+            logits, new_carry = apply_eval(params, mstate, x,
+                                           initial_carry=carry,
+                                           return_carry=True)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return ({"loss_sum": ce.sum(),
+                     "n": jnp.float32(y.shape[0] * y.shape[1])}, new_carry)
+        return eval_fn
 
     if task == "classify":
         def eval_fn(params, mstate, batch):

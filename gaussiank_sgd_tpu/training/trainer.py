@@ -121,34 +121,48 @@ class Trainer:
         optimizer = optax.chain(*chain)
 
         # ---- compression + the fused step ----
+        # LSTM bptt carry across windows (the reference's "repackaging",
+        # SURVEY.md §3.2): hidden state lives in TrainState.carry,
+        # batch-dim sharded; reset at epoch boundaries (train loop).
+        self.recurrent = (cfg.dnn.lower() == "lstm" and cfg.carry_hidden)
         comp = get_compressor(cfg.compressor, density=cfg.density,
                               sigma_scale=cfg.sigma_scale)
         plan = plan_for_params(params, cfg.density, cfg.bucket_size)
         self.plan = plan
         self.ts = build_dp_train_step(
-            make_loss_fn(self.spec, cfg.label_smoothing), optimizer, comp,
+            make_loss_fn(self.spec, cfg.label_smoothing,
+                         recurrent=self.recurrent), optimizer, comp,
             plan, self.mesh,
             num_microbatches=cfg.nsteps_update,
             clip_norm=cfg.clip_norm,
             fold_lr=self.schedule if cfg.fold_lr else None,
+            recurrent=self.recurrent,
         )
+        carry = (self.spec.module.initial_carry(local_bs)
+                 if self.recurrent else ())
         self.state = self.ts.init_state(params, state_rng,
-                                        model_state=model_state)
+                                        model_state=model_state, carry=carry)
         self.is_dense_only = comp.name == "none"
 
         # ---- eval step: shard_map'd sum-reduce over dp ----
-        eval_fn = make_eval_fn(self.spec)
+        eval_fn = make_eval_fn(self.spec, recurrent=self.recurrent)
         axes = tuple(self.mesh.axis_names)
+        self._eval_bs = eval_bs
 
-        def eval_step(params, mstate, batch):
-            sums = eval_fn(params, mstate, batch)
-            return jax.tree.map(
-                lambda x: jax.lax.psum(x, axes), sums)
+        def eval_step(params, mstate, batch, *carry):
+            if self.recurrent:
+                sums, new_carry = eval_fn(params, mstate, batch, carry[0])
+            else:
+                sums, new_carry = eval_fn(params, mstate, batch), None
+            sums = jax.tree.map(lambda x: jax.lax.psum(x, axes), sums)
+            return (sums, new_carry) if self.recurrent else sums
 
+        in_specs = (P(), P(), P(axes)) + ((P(axes),) if self.recurrent
+                                          else ())
+        out_specs = (P(), P(axes)) if self.recurrent else P()
         self.eval_step = jax.jit(jax.shard_map(
             eval_step, mesh=self.mesh,
-            in_specs=(P(), P(), P(axes)), out_specs=P(),
-            check_vma=False))
+            in_specs=in_specs, out_specs=out_specs, check_vma=False))
 
         # ---- resume ----
         if cfg.resume:
@@ -219,6 +233,11 @@ class Trainer:
             self.timers.start("step")
             step = self.step if not hasattr(self, "_step_cache") else \
                 self._step_cache
+            if (self.recurrent and step % self.steps_per_epoch == 0
+                    and step > 0):
+                # fresh text stream at each epoch wrap -> fresh carry
+                self.state = self.state._replace(carry=jax.tree.map(
+                    jnp.zeros_like, self.state.carry))
             fn = (self.ts.dense_step if self._in_warmup(step)
                   else self.ts.sparse_step)
             self.state, m = fn(self.state, batch)
@@ -288,13 +307,22 @@ class Trainer:
     def test(self, epoch: Optional[int] = None) -> Dict[str, float]:
         """Full eval pass (reference ``trainer.test(epoch)``)."""
         totals: Dict[str, float] = {}
+        # LM eval threads hidden state across the contiguous test windows
+        # (same repackaging as training; fresh carry per eval pass)
+        carry = (self.spec.module.initial_carry(self._eval_bs)
+                 if self.recurrent else None)
         for i, batch in enumerate(self.test_ds.epoch()):
             if (self.cfg.eval_max_batches is not None
                     and i >= self.cfg.eval_max_batches):
                 break
             batch = shard_batch(self.mesh, batch)
-            sums = jax.device_get(self.eval_step(
-                self.state.params, self.state.model_state, batch))
+            if self.recurrent:
+                sums, carry = self.eval_step(
+                    self.state.params, self.state.model_state, batch, carry)
+                sums = jax.device_get(sums)
+            else:
+                sums = jax.device_get(self.eval_step(
+                    self.state.params, self.state.model_state, batch))
             for k, v in sums.items():
                 totals[k] = totals.get(k, 0.0) + float(v)
         n = max(totals.get("n", 1.0), 1.0)

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Launch every BASELINE config (SURVEY.md §6; reference launch-scripts role,
+# SURVEY.md §2 C12). Each config is one command:
+#
+#   python -m gaussiank_sgd_tpu.train --config exp_configs/<name>.json
+#
+# CLI flags given after --config override the file (see training/config.py),
+# e.g. a quick smoke of config 2:
+#
+#   scripts/run_all.sh --max-steps 20 --eval-max-batches 4
+#
+# Multi-worker configs need the devices (real chips, or a virtual CPU mesh
+# via GKSGD_VIRTUAL_CPU=8 which also forces the CPU platform).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [[ -n "${GKSGD_VIRTUAL_CPU:-}" ]]; then
+  # same provisioning recipe as tests/conftest.py, via the env hook in
+  # gaussiank_sgd_tpu/virtual_cpu.py
+  export GKSGD_FORCE_VIRTUAL_CPU="${GKSGD_VIRTUAL_CPU}"
+fi
+
+for cfg in exp_configs/config*.json; do
+  echo "=== ${cfg} ==="
+  python -m gaussiank_sgd_tpu.train --config "${cfg}" "$@"
+done

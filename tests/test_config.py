@@ -1,0 +1,81 @@
+"""Config-file layer (reference exp_configs role, SURVEY.md §2 C12):
+every shipped BASELINE config parses into a valid TrainConfig, and the
+documented precedence (defaults < --config file < explicit CLI flag) holds.
+"""
+
+import argparse
+import glob
+import json
+import os
+
+import pytest
+
+from gaussiank_sgd_tpu.training.config import TrainConfig, add_args, from_args
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIGS = sorted(glob.glob(os.path.join(REPO, "exp_configs", "config*.json")))
+
+
+def parse(argv):
+    p = argparse.ArgumentParser()
+    add_args(p)
+    return from_args(p.parse_args(argv), argv)
+
+
+def test_all_exp_configs_parse():
+    assert len(CONFIGS) == 5, CONFIGS
+    for path in CONFIGS:
+        cfg = parse(["--config", path])
+        assert cfg.dnn and cfg.dataset
+        assert 0 < cfg.density <= 1
+        # every config names a distinct run id for artifact separation
+    ids = [parse(["--config", p]).run_id for p in CONFIGS]
+    assert len(set(ids)) == len(ids)
+
+
+def test_config_models_and_datasets_resolve():
+    """Each config's dnn/dataset pair dispatches in the zoo/data registry."""
+    from gaussiank_sgd_tpu import models
+    for path in CONFIGS:
+        cfg = parse(["--config", path])
+        assert cfg.dnn in models.NAMES
+
+
+def test_cli_overrides_config_file():
+    path = CONFIGS[0]
+    base = parse(["--config", path])
+    over = parse(["--config", path, "--lr", "0.5", "--max-steps", "7"])
+    assert base.lr != 0.5
+    assert over.lr == 0.5 and over.max_steps == 7
+    # explicit flag at its DEFAULT value still overrides the file
+    file_val = json.load(open(path))["batch_size"]
+    d = TrainConfig().batch_size
+    assert file_val != d
+    over2 = parse(["--config", path, "--batch-size", str(d)])
+    assert over2.batch_size == d
+
+
+def test_config_unknown_key_rejected(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"dnn": "resnet20", "typo_key": 1}))
+    with pytest.raises(ValueError, match="typo_key"):
+        parse(["--config", str(bad)])
+
+
+def test_comment_keys_ignored(tmp_path):
+    c = tmp_path / "c.json"
+    c.write_text(json.dumps({"_comment": "hi", "dnn": "vgg16"}))
+    assert parse(["--config", str(c)]).dnn == "vgg16"
+
+
+def test_json_kwargs_flags():
+    cfg = parse(["--model-kwargs", '{"hidden_dim": 64}',
+                 "--dataset-kwargs", '{"vocab_size": 256}'])
+    assert cfg.model_kwargs == {"hidden_dim": 64}
+    assert cfg.dataset_kwargs == {"vocab_size": 256}
+
+
+def test_milestones_list_becomes_tuple(tmp_path):
+    c = tmp_path / "c.json"
+    c.write_text(json.dumps({"lr_milestones": [0.3, 0.6]}))
+    assert parse(["--config", str(c)]).lr_milestones == (0.3, 0.6)
