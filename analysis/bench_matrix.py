@@ -1,0 +1,106 @@
+"""The BASELINE config matrix, measured (SURVEY.md §6, VERDICT r1 item 4).
+
+For each BASELINE config's model shape, measures on the available chip:
+dense step time + sparse step time across a density sweep
+{0.1, 0.01, 0.001} for the two headline selector families (hardware
+approx-top-k and GaussianK threshold estimation), reporting
+examples/sec/chip and the sparse:dense ratio for every cell.
+
+Single-chip scope: this machine exposes ONE TPU chip (SURVEY.md §0), so
+these are per-chip compute+compression numbers — the collective cost at
+8/32/64-way rides ICI and is validated functionally on the virtual mesh
+(tests/) while its byte volume is characterized analytically in the
+metrics (bytes_sent) and in analysis/convergence_parity.py.
+
+Writes analysis/artifacts/bench_matrix.json and a markdown table to
+analysis/artifacts/bench_matrix.md (pasted into BASELINE.md).
+
+Run on the TPU box: python analysis/bench_matrix.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ARTIFACTS = os.path.join(REPO, "analysis", "artifacts")
+
+# (config name, model, dataset, per-chip batch, model_kwargs, n_steps)
+CONFIGS = [
+    ("config1_resnet20", "resnet20", "cifar10", 1024, {}, 40),
+    ("config2_vgg16", "vgg16", "cifar10", 256, {}, 20),
+    ("config3_resnet50", "resnet50", "imagenet", 64, {}, 10),
+    ("config4_lstm_ptb", "lstm", "ptb", 160, {}, 10),
+    ("config5_transformer", "transformer", "wmt", 64, {}, 10),
+]
+DENSITIES = (0.1, 0.01, 0.001)
+COMPRESSORS = ("approxtopk", "gaussian")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true",
+                   help="one density, fewer rounds (smoke)")
+    p.add_argument("--configs", default=None,
+                   help="comma-separated substring filter on config names")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from gaussiank_sgd_tpu.benchlib import bench_model
+
+    densities = (0.001,) if args.quick else DENSITIES
+    rounds = 3 if args.quick else 6
+    os.makedirs(ARTIFACTS, exist_ok=True)
+
+    results = []
+    for name, model, dataset, batch, mkw, n_steps in CONFIGS:
+        if args.configs and not any(s in name for s in
+                                    args.configs.split(",")):
+            continue
+        row = {"config": name, "model": model, "batch_per_chip": batch,
+               "platform": jax.devices()[0].platform, "cells": []}
+        for d in densities:
+            print(f"=== {name} density={d} ===", flush=True)
+            times = bench_model(model, dataset, batch, d, COMPRESSORS,
+                                n_steps=n_steps, rounds=rounds,
+                                model_kwargs=mkw)
+            dense = times["dense"]
+            for c in COMPRESSORS:
+                row["cells"].append({
+                    "density": d, "compressor": c,
+                    "dense_ms": round(1e3 * dense, 3),
+                    "sparse_ms": round(1e3 * times[c], 3),
+                    "ratio": round(dense / times[c], 4),
+                    "ex_per_s_chip": round(batch / times[c], 1),
+                })
+            print(json.dumps(row["cells"][-len(COMPRESSORS):]), flush=True)
+        results.append(row)
+
+    with open(os.path.join(ARTIFACTS, "bench_matrix.json"), "w") as f:
+        json.dump(results, f, indent=2)
+
+    lines = ["| Config | density | compressor | dense ms | sparse ms | "
+             "sparse:dense | ex/s/chip |",
+             "|---|---|---|---|---|---|---|"]
+    for row in results:
+        for c in row["cells"]:
+            lines.append(
+                f"| {row['config']} (b={row['batch_per_chip']}) "
+                f"| {c['density']} | {c['compressor']} | {c['dense_ms']} "
+                f"| {c['sparse_ms']} | {c['ratio']} "
+                f"| {c['ex_per_s_chip']} |")
+    table = "\n".join(lines)
+    with open(os.path.join(ARTIFACTS, "bench_matrix.md"), "w") as f:
+        f.write(table + "\n")
+    print(table)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+"""Convergence parity: compressed-DP vs dense-DP at equal steps.
+
+Reference parity: the reference's de-facto verification strategy is
+convergence-as-test (SURVEY.md §4 item 1 — GaussianK@low density reaches
+~dense accuracy). This script produces that evidence offline: it trains the
+same model with the same seeds under several exchange/compressor arms on the
+8-way virtual mesh and records final loss/top-1 per arm plus per-step curves.
+
+Arms: dense psum | gaussian@density (allgather) | topk@density (allgather) |
+gaussian@density (gTop-k butterfly, SURVEY.md §2.3) — i.e. both the C2 and
+C3 communication paths of the reference.
+
+Artifacts (analysis/artifacts/):
+  convergence_parity.json — summary table (+ bytes/step per arm)
+  convergence_parity_curves.jsonl — per-arm loss curves
+  convergence_parity.png — plot (when matplotlib is available)
+
+Run: python analysis/convergence_parity.py [--steps 300] [--density 0.01]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from gaussiank_sgd_tpu import virtual_cpu  # noqa: E402
+
+ARTIFACTS = os.path.join(REPO, "analysis", "artifacts")
+
+
+def run_arm(name, steps, density, outdir, **overrides):
+    import json as _json
+
+    from gaussiank_sgd_tpu.training.config import TrainConfig
+    from gaussiank_sgd_tpu.training.trainer import Trainer
+
+    cfg = dict(
+        dnn="mnistnet", dataset="mnist", batch_size=8, nworkers=8,
+        lr=0.005, momentum=0.9, weight_decay=0.0, epochs=1, max_steps=steps,
+        compressor="gaussian", density=density, compress_warmup_steps=10,
+        warmup_epochs=0.0, compute_dtype="float32", output_dir=outdir,
+        log_every=10, eval_every_epochs=0, save_every_epochs=0, seed=0,
+        run_id=name,
+    )
+    cfg.update(overrides)
+    t = Trainer(TrainConfig(**cfg))
+    t.train(steps)
+    res = t.test()
+    recs = [_json.loads(l) for l in open(
+        os.path.join(t.run_dir, "metrics.jsonl"))]
+    tr = [r for r in recs if r.get("event") == "train"]
+    t.close()
+    return {
+        "arm": name,
+        "final_loss": tr[-1]["loss"],
+        "val_loss": res["val_loss"],
+        "top1": res.get("top1"),
+        "bytes_per_step_sparse": tr[-1]["bytes_sent"],
+        "curve": [(r["step"], r["loss"]) for r in tr],
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--density", type=float, default=0.01)
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--outdir", default="/tmp/gksgd_parity")
+    args = p.parse_args(argv)
+
+    virtual_cpu.provision(args.devices)
+    virtual_cpu.enable_compile_cache()
+    os.makedirs(ARTIFACTS, exist_ok=True)
+
+    arms = [
+        ("dense", dict(compressor="none")),
+        ("gaussian_allgather", dict(compressor="gaussian")),
+        ("topk_allgather", dict(compressor="topk")),
+        ("gaussian_gtopk", dict(compressor="gaussian", exchange="gtopk")),
+    ]
+    results = []
+    for name, ov in arms:
+        print(f"=== arm {name} ===", flush=True)
+        results.append(run_arm(name, args.steps, args.density,
+                               args.outdir, **ov))
+        r = results[-1]
+        print(f"{name}: final_loss={r['final_loss']:.4f} "
+              f"val_loss={r['val_loss']:.4f} top1={r['top1']:.4f} "
+              f"bytes/step={r['bytes_per_step_sparse']}", flush=True)
+
+    dense = next(r for r in results if r["arm"] == "dense")
+    summary = {
+        "config": {"steps": args.steps, "density": args.density,
+                   "nworkers": args.devices, "model": "mnistnet",
+                   "dataset": "mnist(synthetic)"},
+        "arms": [{k: r[k] for k in
+                  ("arm", "final_loss", "val_loss", "top1",
+                   "bytes_per_step_sparse")} for r in results],
+        "parity": {
+            r["arm"]: {
+                "top1_gap_vs_dense": round(dense["top1"] - r["top1"], 4),
+                "val_loss_ratio_vs_dense":
+                    round(r["val_loss"] / dense["val_loss"], 4),
+            } for r in results if r["arm"] != "dense"
+        },
+    }
+    with open(os.path.join(ARTIFACTS, "convergence_parity.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    with open(os.path.join(ARTIFACTS, "convergence_parity_curves.jsonl"),
+              "w") as f:
+        for r in results:
+            f.write(json.dumps({"arm": r["arm"], "curve": r["curve"]}) + "\n")
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        fig, ax = plt.subplots(figsize=(7, 4.5))
+        for r in results:
+            xs, ys = zip(*r["curve"])
+            ax.plot(xs, ys, label=r["arm"])
+        ax.set_xlabel("step"); ax.set_ylabel("train loss")
+        ax.set_title(f"compressed vs dense DP, density={args.density}, "
+                     f"{args.devices}-way")
+        ax.legend(); fig.tight_layout()
+        fig.savefig(os.path.join(ARTIFACTS, "convergence_parity.png"),
+                    dpi=120)
+    except Exception as e:  # matplotlib optional on this machine
+        print(f"(no plot: {e})")
+    print(json.dumps(summary["parity"], indent=2))
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -3,104 +3,43 @@
 Headline metric (BASELINE north-star, SURVEY.md §6): sparse-step throughput
 as a fraction of dense-step throughput on the same model/batch, target
 >= 0.90 ("sparse must not lose to dense"). Measured on ResNet-20/CIFAR-10 at
-the reference's 8-way global batch (8 workers x 128 = 1024, BASELINE
-configs) with GaussianK-family compression at density 0.1%.
+the reference's 8-way global batch (8 workers x 128 = 1024) with the
+TPU-native selector family at density 0.1%; VGG-16 (BASELINE config 2's
+showcase model, where compression matters most) is measured alongside and
+reported in detail.vgg16.
 
-Measurement methodology (hard-won, see git history): the TPU tunnel on this
-box makes single-dispatch timings meaningless — ``block_until_ready`` can
-return before short remote programs finish, and per-dispatch latency swamps
-sub-ms steps. Every timing here therefore runs N steps inside ONE jitted
-``fori_loop`` (DPTrainStep.make_multi_step) and fences with a scalar
-``device_get``, so one dispatch measures N real device steps.
+Methodology lives in gaussiank_sgd_tpu/benchlib.py: N steps per dispatch via
+a jitted fori_loop, scalar fence, interleaved rotated rounds, min per
+variant. The headline value is the best compressor's ratio (detail names
+the winner). vs_baseline = value / 0.90.
 
-The headline value is the best compressor's ratio (the framework ships
-several TPU-native selectors; the winner is named in detail.compressor).
-vs_baseline = value / 0.90.
+The full BASELINE config matrix (all 5 configs x density sweep) is
+analysis/bench_matrix.py; this file stays minimal for the driver.
 """
 
 from __future__ import annotations
 
 import json
-import time
 
 import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-
-
-def _run_once(multi_step, mk_state, batch, n_steps):
-    state = mk_state()
-    t0 = time.perf_counter()
-    state, m = multi_step(state, batch)
-    _ = float(m.loss)                          # true fence through the tunnel
-    return (time.perf_counter() - t0) / n_steps
-
-
-def bench_model(model, batch_size, density, compressors, n_steps, rounds=8):
-    from gaussiank_sgd_tpu.compressors import get_compressor
-    from gaussiank_sgd_tpu.models import get_model
-    from gaussiank_sgd_tpu.parallel.bucketing import plan_for_params
-    from gaussiank_sgd_tpu.parallel.mesh import (data_parallel_mesh,
-                                                 shard_batch)
-    from gaussiank_sgd_tpu.parallel.trainstep import build_dp_train_step
-    from gaussiank_sgd_tpu.training.losses import make_loss_fn
-
-    mesh = data_parallel_mesh()
-    spec = get_model(model, "cifar10", dtype=jnp.bfloat16)
-    rng = jax.random.PRNGKey(0)
-    x = jax.random.normal(rng, (batch_size,) + spec.input_shape, jnp.float32)
-    y = jax.random.randint(jax.random.PRNGKey(1), (batch_size,), 0,
-                           spec.num_classes)
-    variables = spec.module.init({"params": rng}, x[:2], train=False)
-    params = variables["params"]
-    mstate = {k: v for k, v in variables.items() if k != "params"}
-    plan = plan_for_params(params, density)
-    batch = shard_batch(mesh, (x, y))
-
-    # Build + compile + warm every program FIRST, then time in interleaved
-    # rounds: device speed drifts over minutes (shared/tunneled chip), so
-    # measuring dense and sparse far apart in time fabricates ratios in
-    # either direction. Interleaving puts every variant in every speed
-    # window; min-over-rounds compares best-case to best-case.
-    programs = {}
-    for name in compressors:
-        comp = get_compressor(name, density=density)
-        ts = build_dp_train_step(make_loss_fn(spec),
-                                 optax.sgd(0.1, momentum=0.9), comp, plan,
-                                 mesh)
-
-        def mk(ts=ts):
-            return ts.init_state(params, jax.random.PRNGKey(2),
-                                 model_state=mstate)
-
-        if "dense" not in programs:
-            programs["dense"] = (ts.make_multi_step("dense", n_steps), mk)
-        programs[name] = (ts.make_multi_step("sparse", n_steps), mk)
-
-    for fn, mk in programs.values():          # compile + warm
-        st, m = fn(mk(), batch)
-        _ = float(m.loss)
-
-    out = {k: float("inf") for k in programs}
-    names = list(programs)
-    for r in range(rounds):
-        # rotate the within-round order too — a fixed order hands whatever
-        # first-slot penalty exists to the same variant every round
-        for name in names[r % len(names):] + names[:r % len(names)]:
-            fn, mk = programs[name]
-            out[name] = min(out[name], _run_once(fn, mk, batch, n_steps))
-    return out
 
 
 def main():
-    batch_size, density = 1024, 0.001
+    from gaussiank_sgd_tpu.benchlib import bench_model
+
+    density = 0.001
     compressors = ("approxtopk", "gaussian_pallas", "gaussian")
-    times = bench_model("resnet20", batch_size, density, compressors,
-                        n_steps=40)
-    t_dense = times["dense"]
+
+    times = bench_model("resnet20", "cifar10", 1024, density, compressors,
+                        n_steps=40, rounds=8)
     winner = min(compressors, key=lambda c: times[c])
-    ratio = t_dense / times[winner]
+    ratio = times["dense"] / times[winner]
+
+    vgg = bench_model("vgg16", "cifar10", 256, density,
+                      (winner, "gaussian") if winner != "gaussian"
+                      else (winner,), n_steps=20, rounds=6)
+    vgg_best = min((k for k in vgg if k != "dense"), key=lambda c: vgg[c])
+    vgg_ratio = vgg["dense"] / vgg[vgg_best]
 
     result = {
         "metric": "sparse_vs_dense_step_throughput_ratio",
@@ -108,13 +47,20 @@ def main():
         "unit": "ratio",
         "vs_baseline": round(ratio / 0.90, 4),
         "detail": {
-            "model": "resnet20", "batch": batch_size, "density": density,
+            "model": "resnet20", "batch": 1024, "density": density,
             "compressor": winner,
-            "dense_step_ms": round(1e3 * t_dense, 3),
+            "dense_step_ms": round(1e3 * times["dense"], 3),
             "sparse_step_ms": round(1e3 * times[winner], 3),
-            "sparse_images_per_s": round(batch_size / times[winner], 1),
+            "sparse_images_per_s": round(1024 / times[winner], 1),
             "all_sparse_ms": {c: round(1e3 * times[c], 3)
                               for c in compressors},
+            "vgg16": {
+                "batch": 256, "compressor": vgg_best,
+                "ratio": round(vgg_ratio, 4),
+                "dense_step_ms": round(1e3 * vgg["dense"], 3),
+                "sparse_step_ms": round(1e3 * vgg[vgg_best], 3),
+                "sparse_images_per_s": round(256 / vgg[vgg_best], 1),
+            },
             "methodology": "N-step fori_loop per dispatch, scalar fence, "
                            "interleaved rounds, min per variant",
             "platform": jax.devices()[0].platform,

@@ -1,0 +1,107 @@
+"""Shared benchmark machinery for bench.py and analysis/bench_matrix.py.
+
+Measurement methodology (hard-won, see bench.py docstring): the TPU tunnel
+makes single-dispatch timings meaningless, so every timing runs N steps
+inside ONE jitted ``fori_loop`` (DPTrainStep.make_multi_step) and fences
+with a scalar ``device_get``; dense and sparse variants are timed in
+interleaved, rotated rounds (device speed drifts over minutes on a shared
+chip) and each variant reports its min across rounds.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def make_batch(spec, batch_size: int, rng=None):
+    """Synthesize a (x, y) batch matching the model task's shapes."""
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+    r1, r2 = jax.random.split(rng)
+    if spec.task == "classify":
+        x = jax.random.normal(r1, (batch_size,) + spec.input_shape,
+                              jnp.float32)
+        y = jax.random.randint(r2, (batch_size,), 0, spec.num_classes)
+    elif spec.task == "lm":
+        t = spec.input_shape[0]
+        x = jax.random.randint(r1, (batch_size, t), 0, spec.num_classes)
+        y = jax.random.randint(r2, (batch_size, t), 0, spec.num_classes)
+    elif spec.task == "seq2seq":
+        t = spec.input_shape[0]
+        x = jax.random.randint(r1, (batch_size, t), 1, spec.num_classes)
+        y = jax.random.randint(r2, (batch_size, t), 1, spec.num_classes)
+    elif spec.task == "ctc":
+        x = jax.random.normal(r1, (batch_size,) + spec.input_shape,
+                              jnp.float32)
+        y = jax.random.randint(r2, (batch_size, 16), 1, spec.num_classes)
+    else:
+        raise ValueError(spec.task)
+    return x, y
+
+
+def _run_once(multi_step, mk_state, batch, n_steps):
+    state = mk_state()
+    t0 = time.perf_counter()
+    state, m = multi_step(state, batch)
+    _ = float(m.loss)                          # true fence through the tunnel
+    return (time.perf_counter() - t0) / n_steps
+
+
+def bench_model(model: str, dataset: str, batch_size: int, density: float,
+                compressors: Sequence[str], n_steps: int, rounds: int = 8,
+                include_dense: bool = True, model_kwargs: Optional[dict] = None,
+                dtype=jnp.bfloat16) -> Dict[str, float]:
+    """Per-step seconds for the dense program + each compressor's sparse
+    program on one model. Keys: 'dense' + compressor names."""
+    from .compressors import get_compressor
+    from .models import get_model
+    from .parallel.bucketing import plan_for_params
+    from .parallel.mesh import data_parallel_mesh, shard_batch
+    from .parallel.trainstep import build_dp_train_step
+    from .training.losses import make_loss_fn
+
+    mesh = data_parallel_mesh()
+    spec = get_model(model, dataset, dtype=dtype, **(model_kwargs or {}))
+    rng = jax.random.PRNGKey(0)
+    x, y = make_batch(spec, batch_size)
+    recurrent = model == "lstm"
+    variables = spec.module.init({"params": rng}, x[:2], train=False)
+    params = variables["params"]
+    mstate = {k: v for k, v in variables.items() if k != "params"}
+    plan = plan_for_params(params, density)
+    batch = shard_batch(mesh, (x, y))
+    carry = (spec.module.initial_carry(batch_size) if recurrent else ())
+
+    programs = {}
+    for name in compressors:
+        comp = get_compressor(name, density=density)
+        ts = build_dp_train_step(
+            make_loss_fn(spec, recurrent=recurrent),
+            optax.sgd(0.1, momentum=0.9), comp, plan, mesh,
+            recurrent=recurrent)
+
+        def mk(ts=ts):
+            return ts.init_state(params, jax.random.PRNGKey(2),
+                                 model_state=mstate, carry=carry)
+
+        if include_dense and "dense" not in programs:
+            programs["dense"] = (ts.make_multi_step("dense", n_steps), mk)
+        programs[name] = (ts.make_multi_step("sparse", n_steps), mk)
+
+    for fn, mk in programs.values():          # compile + warm
+        st, m = fn(mk(), batch)
+        _ = float(m.loss)
+
+    out = {k: float("inf") for k in programs}
+    names = list(programs)
+    for r in range(rounds):
+        # rotate the within-round order — a fixed order hands whatever
+        # first-slot penalty exists to the same variant every round
+        for name in names[r % len(names):] + names[:r % len(names)]:
+            fn, mk = programs[name]
+            out[name] = min(out[name], _run_once(fn, mk, batch, n_steps))
+    return out

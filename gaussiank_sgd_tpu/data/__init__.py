@@ -15,7 +15,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from .cifar import make_cifar, make_mnist
-from .loader import ArrayDataset, prefetch
+from .loader import ArrayDataset, BucketedDataset, prefetch
 from .ptb import PTBDataset, make_ptb
 from .synthetic import (synthetic_images, synthetic_seq2seq,
                         synthetic_spectrograms, synthetic_tokens)
@@ -48,12 +48,68 @@ def make_imagenet(data_dir: Optional[str] = None, train: bool = True,
 
 def make_an4(data_dir: Optional[str] = None, train: bool = True,
              batch_size: int = 16, seed: int = 0,
-             synthetic_examples: int = 256,
-             tgt_len: int = 8) -> Tuple[ArrayDataset, int]:
-    """AN4 speech: synthetic spectrogram/label pairs offline (C9)."""
-    x, y = synthetic_spectrograms(synthetic_examples, 161, 200, 29, tgt_len,
-                                  seed=0 if train else 1)
+             synthetic_examples: int = 256, tgt_len: Optional[int] = None,
+             widths: Tuple[int, ...] = (100, 200, 400, 800)):
+    """AN4 speech (SURVEY.md §2 C9).
+
+    Real-data path: ``{data_dir}/an4_{train|val}_manifest.csv`` in the
+    DeepSpeech manifest format (``wav_path,transcript_path`` rows) —
+    wav files featurize to log-spectrograms and batches form per quantized
+    frame width (data/audio.py). Falls back to synthetic spectrogram/label
+    pairs offline.
+
+    ``tgt_len`` (label slots) is honored on BOTH paths when given; the
+    default differs per path (64 for real transcripts, 8 for the short
+    synthetic label strings) because real AN4 utterances are longer.
+    """
+    if data_dir and data_dir != "synthetic":
+        import os
+
+        from .audio import NUM_LABELS, featurize_manifest
+        split = "train" if train else "val"
+        manifest = os.path.join(data_dir, f"an4_{split}_manifest.csv")
+        if os.path.exists(manifest):
+            buckets = featurize_manifest(manifest, widths,
+                                         tgt_len=tgt_len or 64)
+            return (_bucketed_from_arrays(buckets, batch_size, train, seed),
+                    NUM_LABELS)
+    x, y = synthetic_spectrograms(synthetic_examples, 161, 200, 29,
+                                  tgt_len or 8, seed=0 if train else 1)
     return ArrayDataset((x, y), batch_size, shuffle=train, seed=seed), 29
+
+
+def _bucketed_from_arrays(buckets, batch_size: int, train: bool, seed: int):
+    """Build a BucketedDataset, folding under-filled width buckets together
+    (a pool must hold >= batch_size examples to yield a batch)."""
+    def pad_to(x, w):
+        return (np.pad(x, ((0, 0), (0, 0), (0, w - x.shape[2])))
+                if x.shape[2] < w else x)
+
+    merged, pending = [], None
+    for x, y in buckets:                       # ascending widths
+        if pending is not None:
+            px, py = pending
+            x = np.concatenate([pad_to(px, x.shape[2]), x])
+            y = np.concatenate([py, y])
+            pending = None
+        if len(x) < batch_size:
+            pending = (x, y)
+        else:
+            merged.append((x, y))
+    if pending is not None:
+        if merged:                             # fold widest leftover down
+            x, y = merged[-1]
+            px, py = pending
+            w = max(x.shape[2], px.shape[2])
+            merged[-1] = (np.concatenate([pad_to(x, w), pad_to(px, w)]),
+                          np.concatenate([y, py]))
+        else:
+            raise ValueError(
+                f"AN4 manifest has {len(pending[0])} usable examples, "
+                f"fewer than batch_size={batch_size}")
+    pools = [ArrayDataset((x, y), batch_size, shuffle=train, seed=seed + i)
+             for i, (x, y) in enumerate(merged)]
+    return BucketedDataset(pools, seed=seed)
 
 
 def make_wmt(data_dir: Optional[str] = None, train: bool = True,

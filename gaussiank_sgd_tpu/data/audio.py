@@ -1,0 +1,158 @@
+"""AN4 speech featurization: wav -> log-spectrogram + char labels.
+
+Reference parity: the DeepSpeech-style audio pipeline behind the ``lstman4``
+workload (SURVEY.md §2 C9) — manifest CSVs of ``wav_path,transcript_path``
+rows, 16 kHz waveforms framed into 20 ms windows at 10 ms stride, |STFT|
+log-magnitude features (161 frequency bins at n_fft=320), per-utterance
+mean/std normalization, and a character label set with CTC blank at index 0.
+
+Everything is numpy + stdlib ``wave`` (no audio deps on this machine); the
+TPU-shape concern — ragged utterance lengths vs XLA static shapes — is
+handled by *quantized length bucketing*: utterances group into a small set
+of fixed frame widths (each bucket batch compiles once), the TPU-idiomatic
+equivalent of the reference's similar-length BucketingSampler.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import wave
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# DeepSpeech-style label set: CTC blank '_' at 0, then alphabet; 29 labels.
+LABELS = "_'abcdefghijklmnopqrstuvwxyz "
+_CHAR_TO_ID = {c: i for i, c in enumerate(LABELS)}
+NUM_LABELS = len(LABELS)  # 29
+
+SAMPLE_RATE = 16000
+WINDOW_MS = 20.0
+STRIDE_MS = 10.0
+N_FFT = int(SAMPLE_RATE * WINDOW_MS / 1000)        # 320
+N_FREQ = N_FFT // 2 + 1                            # 161 bins
+
+
+def read_wav(path: str) -> Tuple[np.ndarray, int]:
+    """Load a mono PCM wav via stdlib ``wave`` -> (float32 in [-1,1], rate)."""
+    with wave.open(path, "rb") as w:
+        rate = w.getframerate()
+        n = w.getnframes()
+        width = w.getsampwidth()
+        raw = w.readframes(n)
+        channels = w.getnchannels()
+    if width == 2:
+        x = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+    elif width == 1:
+        x = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0) / 128.0
+    elif width == 4:
+        x = np.frombuffer(raw, np.int32).astype(np.float32) / 2147483648.0
+    else:
+        raise ValueError(f"unsupported sample width {width} in {path}")
+    if channels > 1:
+        x = x.reshape(-1, channels).mean(axis=1)
+    return x, rate
+
+
+def log_spectrogram(samples: np.ndarray, rate: int = SAMPLE_RATE,
+                    normalize: bool = True) -> np.ndarray:
+    """[num_samples] -> [N_FREQ, T] log-|STFT| features.
+
+    Hamming window, window/stride from the module constants scaled to the
+    actual sample rate (so non-16k files featurize correctly). Utterance-level
+    mean/std normalization as in DeepSpeech.
+    """
+    n_fft = int(rate * WINDOW_MS / 1000)
+    stride = int(rate * STRIDE_MS / 1000)
+    if len(samples) < n_fft:
+        samples = np.pad(samples, (0, n_fft - len(samples)))
+    n_frames = 1 + (len(samples) - n_fft) // stride
+    idx = (np.arange(n_fft)[None, :]
+           + stride * np.arange(n_frames)[:, None])      # [T, n_fft]
+    frames = samples[idx] * np.hamming(n_fft)[None, :]
+    spec = np.abs(np.fft.rfft(frames, n=N_FFT, axis=1))  # [T, N_FREQ]
+    feat = np.log1p(spec).T.astype(np.float32)           # [N_FREQ, T]
+    if normalize:
+        feat = (feat - feat.mean()) / (feat.std() + 1e-6)
+    return feat
+
+
+def encode_transcript(text: str) -> np.ndarray:
+    """Characters -> int32 ids; unknown chars drop (reference behavior for
+    out-of-label punctuation). Blank/pad id 0 never appears in targets."""
+    ids = [_CHAR_TO_ID[c] for c in text.lower() if c in _CHAR_TO_ID
+           and c != "_"]
+    return np.asarray(ids, np.int32)
+
+
+def decode_labels(ids: Sequence[int]) -> str:
+    return "".join(LABELS[i] for i in ids if 0 < i < NUM_LABELS)
+
+
+def read_manifest(path: str) -> List[Tuple[str, str]]:
+    """DeepSpeech manifest: ``wav_path,transcript_path`` per row; relative
+    paths resolve against the manifest's directory."""
+    base = os.path.dirname(os.path.abspath(path))
+    rows = []
+    with open(path, newline="") as f:
+        for row in csv.reader(f):
+            if not row or row[0].startswith("#"):
+                continue
+            wav, txt = row[0].strip(), row[1].strip()
+            rows.append((os.path.join(base, wav) if not os.path.isabs(wav)
+                         else wav,
+                         os.path.join(base, txt) if not os.path.isabs(txt)
+                         else txt))
+    return rows
+
+
+def quantize_width(t: int, widths: Sequence[int]) -> int:
+    """Smallest bucket width >= t (longest bucket if t exceeds them all)."""
+    for w in sorted(widths):
+        if t <= w:
+            return w
+    return max(widths)
+
+
+def featurize_manifest(
+    manifest_path: str,
+    widths: Sequence[int] = (100, 200, 400, 800),
+    tgt_len: int = 64,
+) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Featurize every manifest row into per-width buckets.
+
+    Returns a list of ``(x [N_b, N_FREQ, W], y [N_b, tgt_len])`` pairs, one
+    per non-empty bucket width W (ascending). Features pad with zeros to the
+    bucket width (truncate to the largest); labels pad with 0 (CTC blank =
+    padding sentinel, matching training/losses.py's ctc masking).
+    """
+    per_w = {}
+    for wav_path, txt_path in read_manifest(manifest_path):
+        samples, rate = read_wav(wav_path)
+        feat = log_spectrogram(samples, rate)
+        with open(txt_path) as f:
+            labels = encode_transcript(f.read().strip())
+        w = quantize_width(feat.shape[1], widths)
+        feat = feat[:, :w]
+        if feat.shape[1] < w:
+            feat = np.pad(feat, ((0, 0), (0, w - feat.shape[1])))
+        y = labels[:tgt_len]
+        if len(y) < tgt_len:
+            y = np.pad(y, (0, tgt_len - len(y)))
+        per_w.setdefault(w, []).append((feat, y))
+    return [(np.stack([f for f, _ in items]).astype(np.float32),
+             np.stack([y for _, y in items]).astype(np.int32))
+            for w, items in sorted(per_w.items())]
+
+
+def write_wav(path: str, samples: np.ndarray,
+              rate: int = SAMPLE_RATE) -> None:
+    """float32 [-1,1] -> 16-bit PCM wav (test fixtures / tooling)."""
+    pcm = np.clip(samples, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype(np.int16)
+    with wave.open(path, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(pcm.tobytes())

@@ -60,6 +60,40 @@ class ArrayDataset:
             yield from self.epoch()
 
 
+class BucketedDataset:
+    """Batches from length-homogeneous pools (quantized length bucketing).
+
+    Reference parity: the DeepSpeech-style similar-length BucketingSampler
+    behind the AN4 workload (SURVEY.md §2 C9), reshaped for XLA: each pool
+    holds utterances padded to ONE static frame width, so every batch has
+    one of a handful of fixed shapes (one compile per width) instead of a
+    ragged shape per batch. An epoch interleaves pool batches in shuffled
+    order; every pool finishes exactly once per epoch.
+    """
+
+    def __init__(self, pools: Sequence[ArrayDataset], seed: int = 0):
+        assert pools
+        self.pools = list(pools)
+        self.batch_size = pools[0].batch_size
+        self.steps_per_epoch = sum(p.steps_per_epoch for p in pools)
+        self.num_examples = sum(p.num_examples for p in pools)
+        self._rng = np.random.default_rng(seed)
+
+    def epoch(self, epoch_seed: Optional[int] = None) -> Iterator[tuple]:
+        rng = (np.random.default_rng(epoch_seed) if epoch_seed is not None
+               else self._rng)
+        schedule = np.repeat(np.arange(len(self.pools)),
+                             [p.steps_per_epoch for p in self.pools])
+        rng.shuffle(schedule)
+        iters = [p.epoch(epoch_seed=epoch_seed) for p in self.pools]
+        for i in schedule:
+            yield next(iters[i])
+
+    def __iter__(self):
+        while True:
+            yield from self.epoch()
+
+
 def prefetch(it: Iterator, depth: int = 2) -> Iterator:
     """Run ``it`` in a daemon thread, keeping ``depth`` batches ready.
 

@@ -15,6 +15,7 @@ import os
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -57,6 +58,14 @@ def restore_checkpoint(path: str, target: TrainState,
     Orbax restores COMMITTED arrays, so restoring with the raw shardings of a
     freshly-initialized target (single-device, uncommitted) would pin
     everything to device 0 and break the next jitted step.
+
+    **Worker-count changes** (elastic restore, SURVEY.md §5 "Failure
+    detection"): the per-worker EF residual is [P, N]; restoring onto a
+    P' != P mesh redistributes the residual mass — each new worker row gets
+    ``sum_p(old_rows) / P'``, preserving the total un-sent gradient mass
+    (what EF convergence depends on; which worker re-sends it is
+    immaterial since every row enters the same summed exchange). The
+    reference cannot do this at all (it drops EF state from checkpoints).
     """
     ckptr = ocp.StandardCheckpointer()
 
@@ -66,21 +75,65 @@ def restore_checkpoint(path: str, target: TrainState,
         return jax.ShapeDtypeStruct(
             x.shape, x.dtype, sharding=sharding or x.sharding)
 
+    # detect a worker-count mismatch from the checkpoint's own metadata
+    meta = ckptr.metadata(path).item_metadata
+    old_p = int(meta["ef_residual"].shape[0])
+    new_p = int(target.ef_residual.shape[0])
+    ef_dtype = target.ef_residual.dtype
+    n_flat = int(target.ef_residual.shape[1])
+    carry_leaves = jax.tree_util.tree_leaves(target.carry)
+
+    def _old_shape_carry(sharding=None):
+        """Abstract carry at the CHECKPOINT's shapes (its leading dim is the
+        old global batch = per-worker batch x old P, which cannot map onto
+        the new worker geometry — restored only to satisfy orbax, then
+        replaced with fresh zeros below)."""
+        old_leaves = jax.tree_util.tree_leaves(meta["carry"])
+        treedef = jax.tree_util.tree_structure(target.carry)
+        return jax.tree_util.tree_unflatten(treedef, [
+            jax.ShapeDtypeStruct(tuple(m.shape), t.dtype, sharding=sharding)
+            for m, t in zip(old_leaves, carry_leaves)])
+
     if mesh is not None:
         repl = NamedSharding(mesh, P())
         dp = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+        # on a mismatch the old rows restore REPLICATED (old_p need not tile
+        # the new mesh) and redistribute below
+        ef_abstract = (sds(target.ef_residual, dp) if old_p == new_p else
+                       jax.ShapeDtypeStruct((old_p, n_flat), ef_dtype,
+                                            sharding=repl))
+        carry_abstract = (jax.tree.map(lambda x: sds(x, dp), target.carry)
+                          if old_p == new_p else _old_shape_carry(repl))
         abstract = TrainState(
             step=sds(target.step, repl),
             params=jax.tree.map(lambda x: sds(x, repl), target.params),
             model_state=jax.tree.map(lambda x: sds(x, repl),
                                      target.model_state),
             opt_state=jax.tree.map(lambda x: sds(x, repl), target.opt_state),
-            ef_residual=sds(target.ef_residual, dp),
+            ef_residual=ef_abstract,
             rng=sds(target.rng, repl),
-            carry=jax.tree.map(lambda x: sds(x, dp), target.carry),
+            carry=carry_abstract,
         )
     else:
         abstract = jax.tree.map(sds, target)
+        if old_p != new_p:
+            abstract = abstract._replace(
+                ef_residual=jax.ShapeDtypeStruct((old_p, n_flat), ef_dtype),
+                carry=_old_shape_carry())
     restored = ckptr.restore(path, abstract)
-    return TrainState(*restored) if not isinstance(restored, TrainState) \
-        else restored
+    if not isinstance(restored, TrainState):
+        restored = TrainState(*restored)
+    if old_p != new_p:
+        # mass-preserving redistribution: every new row = total/new_p
+        total = jnp.sum(restored.ef_residual, axis=0)
+        ef = jnp.tile((total / new_p)[None, :], (new_p, 1)).astype(ef_dtype)
+        # the recurrent carry restarts from zeros: its rows are batch rows
+        # of the OLD worker geometry and cannot be remapped; warm-up costs
+        # a few windows, convergence state (params/opt/EF) is preserved
+        carry = jax.tree.map(jnp.zeros_like, target.carry)
+        if mesh is not None:
+            dp_sh = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+            ef = jax.device_put(ef, dp_sh)
+            carry = jax.tree.map(lambda x: jax.device_put(x, dp_sh), carry)
+        restored = restored._replace(ef_residual=ef, carry=carry)
+    return restored

@@ -54,8 +54,13 @@ class TrainConfig:
     density: float = 0.001
     sigma_scale: Optional[float] = None
     bucket_size: Optional[int] = None       # None=whole-model, 0=per-tensor
+    bucket_policy: str = "greedy"           # 'greedy' (tensor-boundary merge)
+                                            # | 'uniform' (equal flat chunks,
+                                            # vectorized compress — scalable)
     compress_warmup_steps: int = 0          # dense allreduce for first N steps
     fold_lr: bool = False                   # EF on lr-scaled grads (§2.3 note)
+    exchange: str = "allgather"             # sparse exchange: 'allgather'
+                                            # (C2 path) | 'gtopk' (C3 tree)
 
     # numerics
     compute_dtype: str = "bfloat16"         # MXU-native compute
@@ -137,6 +142,12 @@ def add_args(p: argparse.ArgumentParser, suppress_defaults: bool = False) -> Non
     p.add_argument("--sigma-scale", dest="sigma_scale", type=float,
                    default=None)
     p.add_argument("--bucket-size", dest="bucket_size", type=int, default=None)
+    p.add_argument("--bucket-policy", dest="bucket_policy",
+                   choices=("greedy", "uniform"), default=d.bucket_policy)
+    p.add_argument("--exchange", choices=("allgather", "gtopk"),
+                   default=d.exchange,
+                   help="sparse exchange: allgather (reference C2) or the "
+                        "gTop-k ppermute butterfly (reference C3)")
     p.add_argument("--compress-warmup-steps", dest="compress_warmup_steps",
                    type=int, default=d.compress_warmup_steps)
     p.add_argument("--fold-lr", dest="fold_lr",

@@ -127,7 +127,8 @@ class Trainer:
         self.recurrent = (cfg.dnn.lower() == "lstm" and cfg.carry_hidden)
         comp = get_compressor(cfg.compressor, density=cfg.density,
                               sigma_scale=cfg.sigma_scale)
-        plan = plan_for_params(params, cfg.density, cfg.bucket_size)
+        plan = plan_for_params(params, cfg.density, cfg.bucket_size,
+                               policy=cfg.bucket_policy)
         self.plan = plan
         self.ts = build_dp_train_step(
             make_loss_fn(self.spec, cfg.label_smoothing,
@@ -137,6 +138,7 @@ class Trainer:
             clip_norm=cfg.clip_norm,
             fold_lr=self.schedule if cfg.fold_lr else None,
             recurrent=self.recurrent,
+            exchange=cfg.exchange,
         )
         carry = (self.spec.module.initial_carry(local_bs)
                  if self.recurrent else ())

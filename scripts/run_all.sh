@@ -14,13 +14,17 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+EXTRA=()
 if [[ -n "${GKSGD_VIRTUAL_CPU:-}" ]]; then
   # same provisioning recipe as tests/conftest.py, via the env hook in
-  # gaussiank_sgd_tpu/virtual_cpu.py
+  # gaussiank_sgd_tpu/virtual_cpu.py. Configs 3/5 request 32/64-way DP;
+  # cap every config to the virtual device count (nworkers 0 = all
+  # devices) — user flags in "$@" still win (argparse last-wins).
   export GKSGD_FORCE_VIRTUAL_CPU="${GKSGD_VIRTUAL_CPU}"
+  EXTRA=(--nworkers 0)
 fi
 
 for cfg in exp_configs/config*.json; do
   echo "=== ${cfg} ==="
-  python -m gaussiank_sgd_tpu.train --config "${cfg}" "$@"
+  python -m gaussiank_sgd_tpu.train --config "${cfg}" "${EXTRA[@]}" "$@"
 done

@@ -1,0 +1,132 @@
+"""Bucketing at scale (VERDICT r1 weak #4): the 'uniform' policy's
+vectorized compression path must (a) match the unrolled per-bucket loop
+bit-for-bit, (b) keep the EF invariant under zero padding, and (c) keep
+compile cost O(1) in bucket count where the unrolled loop is O(n_buckets).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gaussiank_sgd_tpu.compressors import get_compressor
+from gaussiank_sgd_tpu.compressors.base import decompress
+from gaussiank_sgd_tpu.parallel.bucketing import (BucketPlan, make_bucket_plan,
+                                                  plan_for_params)
+from gaussiank_sgd_tpu.parallel.trainstep import compress_buckets
+
+
+def test_uniform_plan_shape():
+    plan = make_bucket_plan([1000, 500, 30], 0.01, bucket_size=256,
+                            policy="uniform")
+    assert plan.uniform
+    assert all(b.size == 256 for b in plan.buckets)
+    assert len(plan.buckets) == 6            # ceil(1530/256)
+    assert len({b.k for b in plan.buckets}) == 1
+    with pytest.raises(ValueError):
+        make_bucket_plan([10], 0.1, bucket_size=0, policy="uniform")
+    with pytest.raises(ValueError):
+        make_bucket_plan([10], 0.1, bucket_size=4, policy="nope")
+
+
+@pytest.mark.parametrize("name", ["topk", "gaussian", "randomkec"])
+def test_uniform_matches_unrolled_loop(name):
+    """vmap path == loop path on a divisible total (identical chunks)."""
+    n, chunk = 4096, 512
+    spec = get_compressor(name, density=0.05)
+    acc = jax.random.normal(jax.random.PRNGKey(0), (n,))
+    uni = make_bucket_plan([n], 0.05, bucket_size=chunk, policy="uniform")
+    # greedy per-tensor plan over equal fake tensors = same chunks, but
+    # forced down the unrolled path
+    loop = BucketPlan(uni.buckets, n, uniform=False)
+    rng = jax.random.PRNGKey(7)
+    c_u, r_u, n_u = compress_buckets(spec, uni, acc, rng)
+    c_l, r_l, n_l = compress_buckets(spec, loop, acc, rng)
+    np.testing.assert_array_equal(np.asarray(r_u), np.asarray(r_l))
+    assert int(n_u) == int(n_l)
+    if not spec.requires_rng:
+        # rng folding differs between paths, so indices compare only for
+        # deterministic compressors
+        np.testing.assert_array_equal(np.asarray(c_u.indices),
+                                      np.asarray(c_l.indices))
+        np.testing.assert_array_equal(np.asarray(c_u.values),
+                                      np.asarray(c_l.values))
+
+
+@pytest.mark.parametrize("name", ["topk", "gaussian"])
+def test_uniform_padding_keeps_ef_invariant(name):
+    """Non-divisible total: sent + residual == acc, nothing leaks from pad."""
+    n, chunk = 1000, 384                     # pads 1152, last chunk 232 real
+    spec = get_compressor(name, density=0.05)
+    acc = jax.random.normal(jax.random.PRNGKey(1), (n,)) + 0.1
+    plan = make_bucket_plan([n], 0.05, bucket_size=chunk, policy="uniform")
+    comp, residual, _ = compress_buckets(spec, plan, acc,
+                                         jax.random.PRNGKey(0))
+    assert residual.shape == (n,)
+    sent = decompress(comp, n)               # OOB pad indices drop; val 0
+    np.testing.assert_allclose(np.asarray(sent + residual), np.asarray(acc),
+                               rtol=1e-6, atol=1e-7)
+
+
+def _lowered_size(plan, spec, n):
+    acc = jnp.zeros((n,), jnp.float32)
+
+    def f(acc, rng):
+        c, r, s = compress_buckets(spec, plan, acc, rng)
+        return c.indices, c.values, r, s
+
+    return len(jax.jit(f).lower(acc, jax.random.PRNGKey(0)).as_text())
+
+
+def test_uniform_hlo_size_constant_in_bucket_count():
+    """The scalability claim itself: program size must not grow with bucket
+    count on the uniform path (it does, linearly, on the unrolled path)."""
+    spec = get_compressor("gaussian", density=0.01)
+    small = make_bucket_plan([1 << 14], 0.01, bucket_size=1 << 12,
+                             policy="uniform")      # 4 chunks
+    big = make_bucket_plan([1 << 18], 0.01, bucket_size=1 << 12,
+                           policy="uniform")        # 64 chunks
+    s, b = _lowered_size(small, spec, 1 << 14), _lowered_size(big, spec,
+                                                              1 << 18)
+    assert b < 2.0 * s, (s, b)
+    # unrolled comparison at the same bucket counts: super-linear growth
+    small_l = BucketPlan(small.buckets, 1 << 14, uniform=False)
+    big_l = BucketPlan(big.buckets, 1 << 18, uniform=False)
+    sl = _lowered_size(small_l, spec, 1 << 14)
+    bl = _lowered_size(big_l, spec, 1 << 18)
+    assert bl > 5.0 * sl, (sl, bl)
+
+
+def test_resnet50_uniform_plan_compiles_and_runs():
+    """ResNet-50-scale (25.6M params) uniform-bucketed compression: the
+    whole point of the policy — compiles fast and runs on CPU devices."""
+    from gaussiank_sgd_tpu.models import get_model
+    spec_m = get_model("resnet50", "imagenet")
+    shapes = jax.eval_shape(
+        lambda r: spec_m.module.init(
+            {"params": r}, jnp.zeros((1, 64, 64, 3)), train=False),
+        jax.random.PRNGKey(0))
+    sizes = [int(np.prod(x.shape))
+             for x in jax.tree_util.tree_leaves(shapes["params"])]
+    total = sum(sizes)
+    assert total > 20_000_000 and len(sizes) > 150
+    plan = make_bucket_plan(sizes, 0.001, bucket_size=1 << 22,
+                            policy="uniform")
+    spec = get_compressor("gaussian", density=0.001)
+    acc = jax.random.normal(jax.random.PRNGKey(0), (total,))
+
+    def f(acc, rng):
+        c, r, s = compress_buckets(spec, plan, acc, rng)
+        return c.indices, c.values, r, s
+
+    t0 = time.time()
+    idx, val, res, nsel = jax.jit(f)(acc, jax.random.PRNGKey(0))
+    jax.block_until_ready(res)
+    elapsed = time.time() - t0
+    assert elapsed < 120, f"compile+run took {elapsed:.1f}s"
+    k_total = plan.total_k
+    assert idx.shape[0] == k_total
+    # selection lands near the target density
+    assert 0.2 * k_total < int(nsel) < 5 * k_total

