@@ -1,0 +1,64 @@
+"""One-off: finish the bench matrix's config5 (transformer) cells and merge
+with the recovered configs 1-4. Dense is measured once (it does not depend
+on density) to cut compile count on the 1-core host."""
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RAW = sys.argv[1]           # recovered stdout of the first matrix run
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "artifacts")
+
+batches = {"config1_resnet20": 1024, "config2_vgg16": 256,
+           "config3_resnet50": 64, "config4_lstm_ptb": 160,
+           "config5_transformer": 64}
+rows, cur = {}, None
+for line in open(RAW).read().splitlines():
+    m = re.match(r"=== (config\d\S*) density", line)
+    if m:
+        cur = m.group(1)
+        continue
+    if line.startswith("[{") and cur and not cur.startswith("config5"):
+        rows.setdefault(cur, {"config": cur, "model": cur.split("_")[1],
+                              "batch_per_chip": batches[cur],
+                              "platform": "tpu", "cells": []})
+        rows[cur]["cells"].extend(json.loads(line))
+results = [rows[k] for k in sorted(rows)]
+print("recovered:", [(r["config"], len(r["cells"])) for r in results],
+      flush=True)
+
+from gaussiank_sgd_tpu.benchlib import bench_model
+
+row = {"config": "config5_transformer", "model": "transformer",
+       "batch_per_chip": 64, "platform": "tpu", "cells": []}
+dense_ms = None
+for d in (0.1, 0.01, 0.001):
+    print(f"=== config5 density={d} ===", flush=True)
+    t = bench_model("transformer", "wmt", 64, d, ("approxtopk", "gaussian"),
+                    n_steps=10, rounds=3, include_dense=dense_ms is None)
+    if dense_ms is None:
+        dense_ms = t["dense"]
+    for c in ("approxtopk", "gaussian"):
+        row["cells"].append({
+            "density": d, "compressor": c,
+            "dense_ms": round(1e3 * dense_ms, 3),
+            "sparse_ms": round(1e3 * t[c], 3),
+            "ratio": round(dense_ms / t[c], 4),
+            "ex_per_s_chip": round(64 / t[c], 1)})
+    print(json.dumps(row["cells"][-2:]), flush=True)
+results.append(row)
+os.makedirs(OUT, exist_ok=True)
+with open(os.path.join(OUT, "bench_matrix.json"), "w") as f:
+    json.dump(results, f, indent=2)
+lines = ["| Config | density | compressor | dense ms | sparse ms | "
+         "sparse:dense | ex/s/chip |", "|---|---|---|---|---|---|---|"]
+for r in results:
+    for c in r["cells"]:
+        lines.append(f"| {r['config']} (b={r['batch_per_chip']}) "
+                     f"| {c['density']} | {c['compressor']} | {c['dense_ms']} "
+                     f"| {c['sparse_ms']} | {c['ratio']} "
+                     f"| {c['ex_per_s_chip']} |")
+open(os.path.join(OUT, "bench_matrix.md"), "w").write("\n".join(lines) + "\n")
+print("WROTE", len(results), "configs", flush=True)

@@ -81,9 +81,10 @@ def main(argv=None):
                 })
             print(json.dumps(row["cells"][-len(COMPRESSORS):]), flush=True)
         results.append(row)
-
-    with open(os.path.join(ARTIFACTS, "bench_matrix.json"), "w") as f:
-        json.dump(results, f, indent=2)
+        # write incrementally: an hour of chip measurements must survive a
+        # crash in a later config
+        with open(os.path.join(ARTIFACTS, "bench_matrix.json"), "w") as f:
+            json.dump(results, f, indent=2)
 
     lines = ["| Config | density | compressor | dense ms | sparse ms | "
              "sparse:dense | ex/s/chip |",

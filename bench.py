@@ -28,7 +28,7 @@ def main():
     from gaussiank_sgd_tpu.benchlib import bench_model
 
     density = 0.001
-    compressors = ("approxtopk", "gaussian_pallas", "gaussian")
+    compressors = ("approxtopk", "gaussian_warm", "gaussian")
 
     times = bench_model("resnet20", "cifar10", 1024, density, compressors,
                         n_steps=40, rounds=8)

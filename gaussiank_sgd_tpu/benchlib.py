@@ -69,7 +69,8 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
     rng = jax.random.PRNGKey(0)
     x, y = make_batch(spec, batch_size)
     recurrent = model == "lstm"
-    variables = spec.module.init({"params": rng}, x[:2], train=False)
+    init_inputs = ((x[:2], y[:2]) if spec.task == "seq2seq" else (x[:2],))
+    variables = spec.module.init({"params": rng}, *init_inputs, train=False)
     params = variables["params"]
     mstate = {k: v for k, v in variables.items() if k != "params"}
     plan = plan_for_params(params, density)

@@ -16,7 +16,7 @@ from typing import Callable, NamedTuple, Optional
 
 from .base import CompressResult
 from .exact import approx_topk_compress, none_compress, topk_compress
-from .gaussian import gaussiank_compress
+from .gaussian import gaussian_warm_compress, gaussiank_compress
 from .randomk import randomk_compress, randomkec_compress
 from .sampling import dgc_compress, redsync_compress, redsynctrim_compress
 
@@ -31,6 +31,12 @@ class CompressorSpec(NamedTuple):
     # tensor's numel, not a function of k — consumers must take the dense
     # path (psum) instead of pre-sizing sparse buffers for it.
     out_k: Optional[Callable[[int], int]]
+    # Stateful compressors (warm-started thresholds) carry a per-bucket
+    # scalar across steps: fn is (acc, k, state[, rng]) ->
+    # (CompressResult, new_state); the train step threads the state as
+    # a per-worker [n_buckets] array in TrainState.comp_state.
+    stateful: bool = False
+    init_state: float = 0.0             # initial per-bucket state scalar
 
 
 def get_compressor(name: str, *, density: float = 0.001,
@@ -56,6 +62,13 @@ def get_compressor(name: str, *, density: float = 0.001,
         fn = functools.partial(gaussiank_compress, density=density,
                                sigma_scale=sigma_scale)
         return CompressorSpec("gaussian", fn, False, True, lambda k: k)
+    if name in ("gaussian_warm", "gaussianw"):
+        # TPU-first flagship variant: threshold carried across steps as
+        # compressor state, zero search passes in steady state (gaussian.py)
+        fn = functools.partial(gaussian_warm_compress, density=density,
+                               sigma_scale=sigma_scale)
+        return CompressorSpec("gaussian_warm", fn, False, True,
+                              lambda k: k, stateful=True)
     if name in ("gaussian_pallas", "gaussianp"):
         # same selection contract as 'gaussian', threshold found by the
         # 3-pass Pallas kernel estimator (ops/pallas_select.py, SURVEY §7
@@ -81,5 +94,6 @@ def get_compressor(name: str, *, density: float = 0.001,
     raise ValueError(f"unknown compressor {name!r}; known: {sorted(NAMES)}")
 
 
-NAMES = ("none", "topk", "approxtopk", "gaussian", "gaussian_pallas",
-         "randomk", "randomkec", "dgcsampling", "redsync", "redsynctrim")
+NAMES = ("none", "topk", "approxtopk", "gaussian", "gaussian_warm",
+         "gaussian_pallas", "randomk", "randomkec", "dgcsampling",
+         "redsync", "redsynctrim")

@@ -63,6 +63,7 @@ def make_an4(data_dir: Optional[str] = None, train: bool = True,
     synthetic label strings) because real AN4 utterances are longer.
     """
     if data_dir and data_dir != "synthetic":
+        import glob
         import os
 
         from .audio import NUM_LABELS, featurize_manifest
@@ -73,6 +74,15 @@ def make_an4(data_dir: Optional[str] = None, train: bool = True,
                                          tgt_len=tgt_len or 64)
             return (_bucketed_from_arrays(buckets, batch_size, train, seed),
                     NUM_LABELS)
+        other = glob.glob(os.path.join(data_dir, "an4_*_manifest.csv"))
+        if other:
+            # one split present but not the requested one: silently mixing
+            # real audio with unrelated synthetic spectrograms would make
+            # eval numbers meaningless — fail loudly instead
+            raise FileNotFoundError(
+                f"{manifest} not found, but {sorted(other)} exist in "
+                f"{data_dir}; provide the {split} manifest (or use "
+                f"data_dir='synthetic' for the all-synthetic fallback)")
     x, y = synthetic_spectrograms(synthetic_examples, 161, 200, 29,
                                   tgt_len or 8, seed=0 if train else 1)
     return ArrayDataset((x, y), batch_size, shuffle=train, seed=seed), 29

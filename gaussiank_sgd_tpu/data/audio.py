@@ -55,23 +55,34 @@ def read_wav(path: str) -> Tuple[np.ndarray, int]:
     return x, rate
 
 
+def resample_to_16k(samples: np.ndarray, rate: int) -> np.ndarray:
+    """Linear-interpolation resample to the canonical 16 kHz."""
+    if rate == SAMPLE_RATE:
+        return samples
+    n_out = int(round(len(samples) * SAMPLE_RATE / rate))
+    return np.interp(
+        np.arange(n_out) * (rate / SAMPLE_RATE),
+        np.arange(len(samples)), samples).astype(np.float32)
+
+
 def log_spectrogram(samples: np.ndarray, rate: int = SAMPLE_RATE,
                     normalize: bool = True) -> np.ndarray:
     """[num_samples] -> [N_FREQ, T] log-|STFT| features.
 
-    Hamming window, window/stride from the module constants scaled to the
-    actual sample rate (so non-16k files featurize correctly). Utterance-level
-    mean/std normalization as in DeepSpeech.
+    Non-16k input resamples to 16 kHz first (linear interp), so the fixed
+    320-sample Hamming window / 160-sample stride and 161 frequency bins
+    hold for every file. Utterance-level mean/std normalization as in
+    DeepSpeech.
     """
-    n_fft = int(rate * WINDOW_MS / 1000)
-    stride = int(rate * STRIDE_MS / 1000)
-    if len(samples) < n_fft:
-        samples = np.pad(samples, (0, n_fft - len(samples)))
-    n_frames = 1 + (len(samples) - n_fft) // stride
-    idx = (np.arange(n_fft)[None, :]
+    samples = resample_to_16k(np.asarray(samples, np.float32), rate)
+    if len(samples) < N_FFT:
+        samples = np.pad(samples, (0, N_FFT - len(samples)))
+    stride = int(SAMPLE_RATE * STRIDE_MS / 1000)
+    n_frames = 1 + (len(samples) - N_FFT) // stride
+    idx = (np.arange(N_FFT)[None, :]
            + stride * np.arange(n_frames)[:, None])      # [T, n_fft]
-    frames = samples[idx] * np.hamming(n_fft)[None, :]
-    spec = np.abs(np.fft.rfft(frames, n=N_FFT, axis=1))  # [T, N_FREQ]
+    frames = samples[idx] * np.hamming(N_FFT)[None, :]
+    spec = np.abs(np.fft.rfft(frames, axis=1))           # [T, N_FREQ]
     feat = np.log1p(spec).T.astype(np.float32)           # [N_FREQ, T]
     if normalize:
         feat = (feat - feat.mean()) / (feat.std() + 1e-6)

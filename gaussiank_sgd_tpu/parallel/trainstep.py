@@ -66,6 +66,11 @@ class TrainState(NamedTuple):
                              # batch-dim sharded over dp — each worker owns
                              # the carry for its own batch rows. () for
                              # non-recurrent models.
+    comp_state: Any = ()     # stateful-compressor carry (warm-started
+                             # thresholds): float32[num_devices, n_buckets]
+                             # sharded over dp — per worker AND per bucket,
+                             # like ef_residual. () for stateless
+                             # compressors.
 
 
 class StepMetrics(NamedTuple):
@@ -169,7 +174,7 @@ def _clip_by_global_norm(flat_g: jax.Array, clip: Optional[float]):
 
 
 def compress_buckets(spec: CompressorSpec, plan: BucketPlan, acc: jax.Array,
-                     rng: jax.Array):
+                     rng: jax.Array, comp_state: Any = ()):
     """Run the compressor over every bucket; concat packed pairs globally.
 
     Bucket-local indices are offset into the global flat space so the whole
@@ -187,35 +192,46 @@ def compress_buckets(spec: CompressorSpec, plan: BucketPlan, acc: jax.Array,
     see the zeros — same class of approximation as the reference's fused
     buckets mixing tensors.
     """
+    def call(chunk, k, st, rg):
+        """Uniform compressor-call convention: unused st/rg pass through so
+        ONE code path serves all four (stateful x requires_rng) cases, for
+        both the vmapped and the unrolled branch below."""
+        args = (chunk, k) + ((st,) if spec.stateful else ())
+        r = spec.fn(*args, rg) if spec.requires_rng else spec.fn(*args)
+        return r if spec.stateful else (r, st)
+
     if plan.uniform and len(plan.buckets) > 1:
         n_chunks = len(plan.buckets)
         chunk, k = plan.buckets[0].size, plan.buckets[0].k
         padded = n_chunks * chunk
         x = (jnp.pad(acc, (0, padded - acc.shape[0]))
              if padded > acc.shape[0] else acc).reshape(n_chunks, chunk)
-        if spec.requires_rng:
-            rngs = jax.random.split(rng, n_chunks)
-            r = jax.vmap(lambda c, rg: spec.fn(c, k, rg))(x, rngs)
-        else:
-            r = jax.vmap(lambda c: spec.fn(c, k))(x)
+        st = (comp_state if spec.stateful
+              else jnp.zeros((n_chunks,), jnp.float32))
+        rngs = jax.random.split(rng, n_chunks)
+        r, st_new = jax.vmap(lambda c, s, rg: call(c, k, s, rg))(x, st, rngs)
         offs = (jnp.arange(n_chunks, dtype=jnp.int32) * chunk)[:, None]
         comp = CompressedGrad((r.compressed.indices + offs).reshape(-1),
                               r.compressed.values.reshape(-1))
         residual = r.residual.reshape(-1)[:acc.shape[0]]
-        return comp, residual, jnp.sum(r.num_selected)
+        return (comp, residual, jnp.sum(r.num_selected),
+                st_new if spec.stateful else comp_state)
 
     idx_parts, val_parts, res_parts, nsel = [], [], [], jnp.int32(0)
+    st_parts = []
     for i, b in enumerate(plan.buckets):
         chunk = lax.dynamic_slice_in_dim(acc, b.offset, b.size)
-        r = (spec.fn(chunk, b.k, jax.random.fold_in(rng, i))
-             if spec.requires_rng else spec.fn(chunk, b.k))
+        st_i = comp_state[i] if spec.stateful else jnp.float32(0)
+        r, st_new = call(chunk, b.k, st_i, jax.random.fold_in(rng, i))
         idx_parts.append(r.compressed.indices + b.offset)
         val_parts.append(r.compressed.values)
         res_parts.append(r.residual)
+        st_parts.append(st_new)
         nsel = nsel + r.num_selected
     comp = CompressedGrad(jnp.concatenate(idx_parts),
                           jnp.concatenate(val_parts))
-    return comp, jnp.concatenate(res_parts), nsel
+    return (comp, jnp.concatenate(res_parts), nsel,
+            jnp.stack(st_parts) if spec.stateful else comp_state)
 
 
 class DPTrainStep(NamedTuple):
@@ -336,12 +352,15 @@ def build_dp_train_step(
                 flat_g, unravel)
 
     def _apply(state: TrainState, mstate: Any, dense_flat: jax.Array, unravel,
-               new_residual: jax.Array, new_carry: Any):
+               new_residual: jax.Array, new_carry: Any,
+               new_comp_state: Any = None):
         updates, opt_state = optimizer.update(
             unravel(dense_flat), state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return TrainState(state.step + 1, params, mstate, opt_state,
-                          new_residual, state.rng, new_carry)
+                          new_residual, state.rng, new_carry,
+                          state.comp_state if new_comp_state is None
+                          else new_comp_state)
 
     def sparse_step_fn(state: TrainState, batch: Any):
         data_rng, comp_rng = _step_rngs(state)
@@ -349,7 +368,9 @@ def build_dp_train_step(
             state, batch, data_rng)
         scale = fold_lr(state.step) if fold_lr is not None else 1.0
         acc = state.ef_residual[0] + scale * flat_g  # local residual row
-        comp, residual, nsel = compress_buckets(spec, plan, acc, comp_rng)
+        comp, residual, nsel, cstate = compress_buckets(
+            spec, plan, acc, comp_rng,
+            state.comp_state[0] if spec.stateful else ())
         k_packed = comp.indices.shape[0]
 
         if exchange == "gtopk":
@@ -378,7 +399,8 @@ def build_dp_train_step(
                 k_packed * (4 + comp.values.dtype.itemsize))
 
         new_state = _apply(state, mstate, dense, unravel, residual[None, :],
-                           new_carry)
+                           new_carry,
+                           cstate[None, :] if spec.stateful else ())
         return new_state, StepMetrics(
             loss, aux, _pmean(jnp.linalg.norm(flat_g)),
             _pmean(nsel.astype(jnp.float32)), bytes_sent)
@@ -406,7 +428,8 @@ def build_dp_train_step(
     # carry (batch-dim sharded, like the batch itself).
     state_spec = TrainState(step=P(), params=P(), model_state=P(),
                             opt_state=P(), ef_residual=P(axes), rng=P(),
-                            carry=P(axes) if recurrent else P())
+                            carry=P(axes) if recurrent else P(),
+                            comp_state=P(axes) if spec.stateful else P())
 
     def _smap(fn):
         return shard_map(
@@ -456,6 +479,9 @@ def build_dp_train_step(
             ef_residual=jnp.zeros((mesh.size, n_total), grad_dtype),
             rng=rng,
             carry=jax.tree.map(jnp.copy, carry),
+            comp_state=(jnp.full((mesh.size, len(plan.buckets)),
+                                 spec.init_state, jnp.float32)
+                        if spec.stateful else ()),
         )
 
     return DPTrainStep(_wrap(sparse_step_fn), _wrap(dense_step_fn),

@@ -104,6 +104,11 @@ def restore_checkpoint(path: str, target: TrainState,
                                             sharding=repl))
         carry_abstract = (jax.tree.map(lambda x: sds(x, dp), target.carry)
                           if old_p == new_p else _old_shape_carry(repl))
+        cs_abstract = jax.tree.map(
+            lambda x: (sds(x, dp) if old_p == new_p else
+                       jax.ShapeDtypeStruct((old_p,) + tuple(x.shape[1:]),
+                                            x.dtype, sharding=repl)),
+            target.comp_state)
         abstract = TrainState(
             step=sds(target.step, repl),
             params=jax.tree.map(lambda x: sds(x, repl), target.params),
@@ -113,13 +118,18 @@ def restore_checkpoint(path: str, target: TrainState,
             ef_residual=ef_abstract,
             rng=sds(target.rng, repl),
             carry=carry_abstract,
+            comp_state=cs_abstract,
         )
     else:
         abstract = jax.tree.map(sds, target)
         if old_p != new_p:
             abstract = abstract._replace(
                 ef_residual=jax.ShapeDtypeStruct((old_p, n_flat), ef_dtype),
-                carry=_old_shape_carry())
+                carry=_old_shape_carry(),
+                comp_state=jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(
+                        (old_p,) + tuple(x.shape[1:]), x.dtype),
+                    target.comp_state))
     restored = ckptr.restore(path, abstract)
     if not isinstance(restored, TrainState):
         restored = TrainState(*restored)
@@ -131,9 +141,18 @@ def restore_checkpoint(path: str, target: TrainState,
         # of the OLD worker geometry and cannot be remapped; warm-up costs
         # a few windows, convergence state (params/opt/EF) is preserved
         carry = jax.tree.map(jnp.zeros_like, target.carry)
+        # warm-started thresholds: every new worker starts from the old
+        # workers' mean — a sensible warm start, re-calibrated in one step
+        comp_state = jax.tree.map(
+            lambda x: jnp.tile(jnp.mean(x, axis=0, keepdims=True),
+                               (new_p,) + (1,) * (x.ndim - 1)),
+            restored.comp_state)
         if mesh is not None:
             dp_sh = NamedSharding(mesh, P(tuple(mesh.axis_names)))
             ef = jax.device_put(ef, dp_sh)
             carry = jax.tree.map(lambda x: jax.device_put(x, dp_sh), carry)
-        restored = restored._replace(ef_residual=ef, carry=carry)
+            comp_state = jax.tree.map(
+                lambda x: jax.device_put(x, dp_sh), comp_state)
+        restored = restored._replace(ef_residual=ef, carry=carry,
+                                     comp_state=comp_state)
     return restored

@@ -26,5 +26,6 @@ fi
 
 for cfg in exp_configs/config*.json; do
   echo "=== ${cfg} ==="
-  python -m gaussiank_sgd_tpu.train --config "${cfg}" "${EXTRA[@]}" "$@"
+  python -m gaussiank_sgd_tpu.train --config "${cfg}" \
+      ${EXTRA[@]+"${EXTRA[@]}"} "$@"
 done

@@ -61,6 +61,28 @@ def test_log_spectrogram_shape_and_norm():
     assert feat[bin440].mean() > 2.0
 
 
+def test_non_16k_rate_resamples():
+    """44.1 kHz input resamples to 16k: same tone -> same hot bin, full
+    window retained (no silent crop)."""
+    t = np.arange(int(0.5 * 44100)) / 44100
+    x = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)
+    feat = log_spectrogram(x, rate=44100)
+    ref = log_spectrogram(_tone(0.5, 440), rate=SAMPLE_RATE)
+    bin440 = int(round(440 * 320 / SAMPLE_RATE))
+    assert abs(feat.shape[1] - ref.shape[1]) <= 1
+    assert feat[bin440].mean() > 2.0
+    # energy concentrated, not smeared by window truncation
+    assert feat[bin440].mean() > 3 * np.abs(feat[bin440 + 20]).mean()
+
+
+def test_missing_split_manifest_fails_loudly(tmp_path):
+    """train manifest present but val missing must raise, not silently
+    fall back to synthetic eval data."""
+    d = _make_an4_dir(tmp_path, n=10, split="train")
+    with pytest.raises(FileNotFoundError, match="an4_val_manifest"):
+        make_an4(str(d), train=False, batch_size=2)
+
+
 def test_transcript_encode_decode():
     ids = encode_transcript("Hello, World!")         # punctuation drops
     assert decode_labels(ids) == "hello world"

@@ -42,8 +42,8 @@ def test_uniform_matches_unrolled_loop(name):
     # forced down the unrolled path
     loop = BucketPlan(uni.buckets, n, uniform=False)
     rng = jax.random.PRNGKey(7)
-    c_u, r_u, n_u = compress_buckets(spec, uni, acc, rng)
-    c_l, r_l, n_l = compress_buckets(spec, loop, acc, rng)
+    c_u, r_u, n_u, _ = compress_buckets(spec, uni, acc, rng)
+    c_l, r_l, n_l, _ = compress_buckets(spec, loop, acc, rng)
     np.testing.assert_array_equal(np.asarray(r_u), np.asarray(r_l))
     assert int(n_u) == int(n_l)
     if not spec.requires_rng:
@@ -62,7 +62,7 @@ def test_uniform_padding_keeps_ef_invariant(name):
     spec = get_compressor(name, density=0.05)
     acc = jax.random.normal(jax.random.PRNGKey(1), (n,)) + 0.1
     plan = make_bucket_plan([n], 0.05, bucket_size=chunk, policy="uniform")
-    comp, residual, _ = compress_buckets(spec, plan, acc,
+    comp, residual, _, _ = compress_buckets(spec, plan, acc,
                                          jax.random.PRNGKey(0))
     assert residual.shape == (n,)
     sent = decompress(comp, n)               # OOB pad indices drop; val 0
@@ -74,7 +74,7 @@ def _lowered_size(plan, spec, n):
     acc = jnp.zeros((n,), jnp.float32)
 
     def f(acc, rng):
-        c, r, s = compress_buckets(spec, plan, acc, rng)
+        c, r, s, _ = compress_buckets(spec, plan, acc, rng)
         return c.indices, c.values, r, s
 
     return len(jax.jit(f).lower(acc, jax.random.PRNGKey(0)).as_text())
@@ -118,7 +118,7 @@ def test_resnet50_uniform_plan_compiles_and_runs():
     acc = jax.random.normal(jax.random.PRNGKey(0), (total,))
 
     def f(acc, rng):
-        c, r, s = compress_buckets(spec, plan, acc, rng)
+        c, r, s, _ = compress_buckets(spec, plan, acc, rng)
         return c.indices, c.values, r, s
 
     t0 = time.time()
