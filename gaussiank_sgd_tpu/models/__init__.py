@@ -19,6 +19,7 @@ from .mnistnet import MnistNet
 from .resnet import CifarResNet, ResNet50
 from .speech import LSTMAN4
 from .transformer import Transformer
+from .transformer_lm import TransformerLM
 from .vgg import VGG16
 
 
@@ -77,9 +78,17 @@ def get_model(dnn: str, dataset: Optional[str] = None, *,
         vocab = kw.pop("vocab_size", 32000)
         m = Transformer(vocab_size=vocab, dtype=dtype, **kw)
         return ModelSpec("transformer", m, (64,), jnp.int32, vocab, "seq2seq")
+    if dnn in ("transformer_lm", "transformerlm"):
+        # decoder-only LM with optional ring-attention sequence parallelism
+        # (long-context path; models/transformer_lm.py)
+        vocab = kw.pop("vocab_size", 32000)
+        seq_len = kw.pop("seq_len", 256)
+        m = TransformerLM(vocab_size=vocab, dtype=dtype, **kw)
+        return ModelSpec("transformer_lm", m, (seq_len,), jnp.int32, vocab,
+                         "lm")
     raise ValueError(f"unknown dnn {dnn!r}")
 
 
 NAMES = ("resnet20", "resnet32", "resnet44", "resnet56", "resnet110",
          "resnet50", "vgg16", "alexnet", "mnistnet", "lstm", "lstman4",
-         "transformer")
+         "transformer", "transformer_lm")

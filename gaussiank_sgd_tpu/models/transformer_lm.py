@@ -1,0 +1,103 @@
+"""Decoder-only Transformer LM with optional ring-attention sequence
+parallelism — the long-context path (task charter; beyond the reference,
+which has no sequence parallelism, SURVEY.md §2.2).
+
+With ``sp_axis`` set the model must run inside ``shard_map`` on a mesh
+whose last axis is the sequence-parallel axis: every activation holds the
+LOCAL sequence block [B, T/sp, D], positions offset by the shard's block
+start, and attention runs as an ICI ring (parallel/ring_attention.py) —
+K/V blocks rotate, the full [T, T] score matrix never exists anywhere,
+and max context scales linearly with the sp width. Everything else
+(embeddings, MLPs, layernorms, the LM head) is purely local.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.ring_attention import ring_attention
+from .transformer import MLP, sinusoidal_positions
+
+
+class RingSelfAttention(nn.Module):
+    """Causal MHA: local softmax attention, or a sequence-parallel ring
+    when ``sp_axis`` is set (projections are local either way)."""
+
+    dim: int
+    heads: int
+    sp_axis: Optional[str]
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        head_dim = self.dim // self.heads
+        qkv = nn.DenseGeneral((3, self.heads, head_dim), dtype=self.dtype,
+                              name="qkv")(x)            # [B, T, 3, H, D]
+        q, k, v = [jnp.transpose(qkv[:, :, i], (0, 2, 1, 3))
+                   for i in range(3)]                   # [B, H, T, D]
+        if self.sp_axis is not None:
+            out = ring_attention(q, k, v, self.sp_axis, causal=True)
+        else:
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                           preferred_element_type=jnp.float32)
+            s = s * (head_dim ** -0.5)
+            t = s.shape[-1]
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1).astype(self.dtype)
+            out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        out = jnp.transpose(out, (0, 2, 1, 3))          # [B, T, H, D]
+        out = out.reshape(out.shape[:2] + (self.dim,))
+        return nn.Dense(self.dim, dtype=self.dtype, name="proj")(out)
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 32000
+    dim: int = 512
+    heads: int = 8
+    num_layers: int = 6
+    ffn: int = 2048
+    dropout: float = 0.1
+    max_len: int = 2048
+    dtype: Any = jnp.float32
+    sp_axis: Optional[str] = None   # sequence-parallel mesh axis (ring)
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        # tokens: int32[B, T_local] -> logits float[B, T_local, V]
+        # (T_local = T / sp_size when sequence-parallel)
+        embed = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype,
+                         name="embed")
+        pe = jnp.asarray(sinusoidal_positions(self.max_len, self.dim))
+        t_local = tokens.shape[1]
+        if self.sp_axis is not None:
+            # global positions: this shard owns block [my*T_local, ...).
+            # psum of 1 is static inside shard_map, so this guards at trace
+            # time — dynamic_slice would silently CLAMP an out-of-range
+            # start and reuse positions on the trailing shards.
+            sp_size = lax.psum(1, self.sp_axis)
+            assert self.max_len >= sp_size * t_local, (
+                f"max_len={self.max_len} < global sequence "
+                f"{sp_size}x{t_local}; raise max_len")
+            start = lax.axis_index(self.sp_axis) * t_local
+            pos = lax.dynamic_slice_in_dim(pe, start, t_local)
+        else:
+            pos = pe[:t_local]
+        x = embed(tokens) * jnp.sqrt(jnp.float32(self.dim)).astype(self.dtype)
+        x = x + pos.astype(self.dtype)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        for i in range(self.num_layers):
+            h = nn.LayerNorm(dtype=jnp.float32, name=f"ln1_{i}")(x)
+            h = RingSelfAttention(self.dim, self.heads, self.sp_axis,
+                                  self.dtype, name=f"attn_{i}")(h)
+            x = x + nn.Dropout(self.dropout, deterministic=not train)(h)
+            h = nn.LayerNorm(dtype=jnp.float32, name=f"ln2_{i}")(x)
+            x = x + MLP(self.dim, self.ffn, self.dropout,
+                        self.dtype, name=f"mlp_{i}")(h, train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return embed.attend(x.astype(jnp.float32))

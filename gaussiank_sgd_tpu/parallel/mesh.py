@@ -121,6 +121,32 @@ def hierarchical_dp_mesh(ici_size: int,
     return Mesh(arr, ("dcn_dp", "ici_dp"))
 
 
+def dp_sp_mesh(dp_size: int, sp_size: int,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """2-D (dp, sp) mesh: data parallelism x ring-attention sequence
+    parallelism (parallel/ring_attention.py — long-context path, beyond
+    the reference). The sp axis is LAST so the sparse gradient exchange
+    (trainstep gather axis) and the K/V ring both ride the fastest links.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    want = dp_size * sp_size
+    if want > len(devs):
+        raise ValueError(
+            f"requested {dp_size}x{sp_size}={want} devices, have {len(devs)}")
+    devs = devs[:want]
+    # same topology discipline as hierarchical_dp_mesh: the sp rows must be
+    # ICI-neighbor-contiguous or every K/V ring hop silently crosses slow
+    # links; never fall back to a blind reshape on real hardware
+    try:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_device_mesh((dp_size, sp_size), devices=devs)
+    except Exception:
+        if devs and devs[0].platform != "cpu":
+            raise
+        arr = np.asarray(devs).reshape(dp_size, sp_size)
+    return Mesh(arr, ("dp", "sp"))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     """Sharding for model/optimizer state: replicated across dp."""
     return NamedSharding(mesh, P())
@@ -137,7 +163,10 @@ def batch_sharded(mesh: Mesh, axes=None) -> NamedSharding:
     return NamedSharding(mesh, P(axes))
 
 
-def shard_batch(mesh: Mesh, batch):
-    """Place a host batch onto the mesh with the leading dim sharded over dp."""
-    return jax.tree.map(
-        lambda x: jax.device_put(x, batch_sharded(mesh)), batch)
+def shard_batch(mesh: Mesh, batch, spec: Optional[P] = None):
+    """Place a host batch onto the mesh; leading dim sharded over dp by
+    default, or per ``spec`` (e.g. ``P('dp', 'sp')`` for sequence-parallel
+    batches whose dim 1 shards over the sp axis)."""
+    sharding = (NamedSharding(mesh, spec) if spec is not None
+                else batch_sharded(mesh))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)

@@ -1,0 +1,111 @@
+"""Ring attention — sequence-parallel exact attention over an ICI ring.
+
+Long-context support (task charter; beyond the reference, which has no
+sequence parallelism — SURVEY.md §2.2 "explicitly absent"): the sequence
+dim shards over an ``sp`` mesh axis; every device keeps its Q block
+resident and the K/V blocks rotate around the ring via ``lax.ppermute``
+(one neighbor hop per step, riding ICI). Softmax is accumulated online
+(flash-attention style running max / running sum), so the full [T, T]
+score matrix never materializes and attention stays EXACT — numerically
+equal to full softmax attention up to fp reassociation.
+
+Design notes (TPU-first):
+  * the rotation loop is a ``lax.fori_loop`` over sp_size steps — compiled
+    once, no Python unrolling; each step is one ppermute + one fused
+    block-attention matmul pair on the MXU;
+  * causal masking uses GLOBAL positions derived from each block's rotating
+    source index, so causality is correct across shards, and fully-masked
+    (future) blocks contribute zeros through the online-softmax identity
+    (running max starts at -inf and ``exp(-inf - m) = 0``);
+  * communication volume per device per step: 2 * T/P * d floats (K and V
+    blocks), total 2*T*d per full pass — the all-to-all equivalent, but as
+    P neighbor hops that overlap with the per-block compute.
+
+Must run inside ``shard_map`` with ``axis_name`` bound to the sp mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, bias, m_prev, l_prev, o_prev, scale):
+    """One online-softmax accumulation step.
+
+    q: [B, H, Tq, D], k/v: [B, H, Tk, D], bias: [Tq, Tk] additive mask.
+    Carries: m (running max [B,H,Tq]), l (running denom), o (unnormalized
+    numerator [B,H,Tq,D]).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    s = s + bias[None, None, :, :]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    # guard fully-masked rows: keep m finite so exp() stays 0, not NaN
+    m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where((s <= NEG_INF / 2), 0.0, p)
+    alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0,
+                      jnp.exp(m_prev - m_safe))
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1)
+    o_new = (alpha[..., None] * o_prev
+             + jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32))
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    q, k, v: [B, H, T_local, D] — this shard's block of the sequence
+    (global T = T_local * sp_size, contiguous blocks in axis order).
+    Returns [B, H, T_local, D] in q's dtype.
+    """
+    sp = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    t_local, d = q.shape[-2], q.shape[-1]
+    scale = (d ** -0.5) if scale is None else scale
+    qf = q.astype(jnp.float32)
+
+    pos_q = my * t_local + jnp.arange(t_local)           # global q positions
+
+    def bias_for(src):
+        """Additive causal bias of this shard's Q block vs the K/V block
+        that ORIGINATED on shard ``src``."""
+        pos_k = src * t_local + jnp.arange(t_local)
+        if not causal:
+            return jnp.zeros((t_local, t_local), jnp.float32)
+        return jnp.where(pos_q[:, None] >= pos_k[None, :], 0.0, NEG_INF)
+
+    m0 = jnp.full(q.shape[:-1], NEG_INF, jnp.float32)    # [B, H, Tq]
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    o0 = jnp.zeros(qf.shape, jnp.float32)
+
+    def body(i, carry):
+        m, l, o, kb, vb = carry
+        # K/V block currently held arrived from shard (my + i) mod sp
+        src = (my + i) % sp
+        m, l, o = _block_attend(qf, kb.astype(jnp.float32),
+                                vb.astype(jnp.float32),
+                                bias_for(src), m, l, o, scale)
+        # rotate: receive the next block from the right neighbor
+        perm = [(j, (j - 1) % sp) for j in range(sp)]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return m, l, o, kb, vb
+
+    # sp-1 rotations inside the loop; the final held block attends outside
+    # so no dead ppermute pair is paid on the last step
+    m, l, o, kb, vb = lax.fori_loop(0, sp - 1, body, (m0, l0, o0, k, v))
+    m, l, o = _block_attend(qf, kb.astype(jnp.float32),
+                            vb.astype(jnp.float32),
+                            bias_for((my + sp - 1) % sp), m, l, o, scale)
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)

@@ -268,6 +268,7 @@ def build_dp_train_step(
     grad_dtype=jnp.float32,
     exchange: str = "allgather",
     recurrent: bool = False,
+    sp_axis: Optional[str] = None,
 ) -> DPTrainStep:
     """Build the data-parallel train step over ``mesh``.
 
@@ -291,8 +292,21 @@ def build_dp_train_step(
     LossFn) and ``TrainState.carry`` holds batch-dim-sharded hidden state
     that persists across steps — the reference's bptt "repackaging"
     (SURVEY.md §3.2). Pass the initial carry to ``init_state``.
+
+    ``sp_axis``: ring-attention sequence parallelism (long-context path).
+    Must name the mesh's LAST axis; the batch's dim 0 then shards over the
+    other (dp) axes and dim 1 (sequence) over ``sp_axis``, and the model
+    inside ``loss_fn`` is expected to use the axis (e.g.
+    ``TransformerLM(sp_axis=...)``'s K/V ring). Gradient math is unchanged:
+    every (dp, sp) shard contributes partial grads and the existing
+    gather-then-psum exchange sums over both axes.
     """
     axes = tuple(mesh.axis_names)
+    if sp_axis is not None:
+        assert sp_axis == axes[-1], (
+            f"sp_axis {sp_axis!r} must be the mesh's last axis {axes!r}")
+        assert not recurrent, "recurrent carry + sequence parallelism is " \
+                              "not supported (carry rows are batch rows)"
     if exchange == "gtopk":
         assert len(axes) == 1, "gtopk exchange supports 1-D dp meshes only"
         assert mesh.size & (mesh.size - 1) == 0, \
@@ -422,7 +436,11 @@ def build_dp_train_step(
             loss, aux, _pmean(jnp.linalg.norm(flat_g)),
             jnp.float32(n_total), jnp.int32(n_total * 4))
 
-    batch_spec = P(axes)            # leading dim sharded over every dp axis
+    if sp_axis is None:
+        batch_spec = P(axes)        # leading dim sharded over every dp axis
+    else:
+        # dim 0 (examples) over the dp axes, dim 1 (sequence) over sp
+        batch_spec = P(axes[:-1] or None, axes[-1])
     # Pytree-prefix specs: everything in TrainState is replicated except the
     # per-worker ef_residual (leading [num_devices] dim) and the recurrent
     # carry (batch-dim sharded, like the batch itself).

@@ -31,6 +31,9 @@ class TrainConfig:
     nworkers: int = 1                       # dp size; 0 -> all devices
     ici_size: int = 0                       # >0 with dcn_size: hierarchical
     dcn_size: int = 0                       #   (dcn_dp, ici_dp) mesh
+    sp_size: int = 0                        # >1: ring-attention sequence
+                                            # parallelism over a (dp, sp)
+                                            # mesh (transformer_lm only)
 
     # optimization (reference SGD defaults)
     lr: float = 0.1
@@ -116,6 +119,9 @@ def add_args(p: argparse.ArgumentParser, suppress_defaults: bool = False) -> Non
                    help="dp width; 0 = all visible devices")
     p.add_argument("--ici-size", dest="ici_size", type=int, default=d.ici_size)
     p.add_argument("--dcn-size", dest="dcn_size", type=int, default=d.dcn_size)
+    p.add_argument("--sp-size", dest="sp_size", type=int, default=d.sp_size,
+                   help="ring-attention sequence-parallel width "
+                        "(transformer_lm); mesh = nworkers x sp_size")
     p.add_argument("--lr", type=float, default=d.lr)
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--weight-decay", dest="weight_decay", type=float,

@@ -34,7 +34,7 @@ from .. import data as data_lib
 from .. import models as models_lib
 from ..compressors import get_compressor
 from ..parallel.bucketing import plan_for_params
-from ..parallel.mesh import (batch_sharded, data_parallel_mesh,
+from ..parallel.mesh import (batch_sharded, data_parallel_mesh, dp_sp_mesh,
                              hierarchical_dp_mesh, shard_batch)
 from ..parallel.trainstep import build_dp_train_step
 from .checkpoint import (latest_checkpoint, restore_checkpoint,
@@ -60,12 +60,27 @@ class Trainer:
         self.timers = PhaseTimers()
 
         # ---- mesh (SURVEY.md §3.1: hvd.init + device binding -> mesh) ----
-        if cfg.ici_size > 0 and cfg.dcn_size > 0:
+        self.sp = cfg.sp_size if cfg.sp_size > 1 else 0
+        if self.sp:
+            assert cfg.dnn.lower() in ("transformer_lm", "transformerlm"), \
+                "sequence parallelism (--sp-size) is the transformer_lm " \
+                "long-context path"
+            assert not (cfg.ici_size or cfg.dcn_size), \
+                "--sp-size and --ici-size/--dcn-size are mutually " \
+                "exclusive mesh layouts"
+            dp = cfg.nworkers if cfg.nworkers > 0 else (
+                len(jax.devices()) // self.sp)
+            self.mesh = dp_sp_mesh(dp, self.sp)
+            self.nworkers = dp          # dp width: examples per step = bs*dp
+        elif cfg.ici_size > 0 and cfg.dcn_size > 0:
             self.mesh = hierarchical_dp_mesh(cfg.ici_size, cfg.dcn_size)
+            self.nworkers = self.mesh.size
         else:
             n = cfg.nworkers if cfg.nworkers > 0 else None
             self.mesh = data_parallel_mesh(n)
-        self.nworkers = self.mesh.size
+            self.nworkers = self.mesh.size
+        # sequence-parallel batches shard dim 1 (sequence) over 'sp'
+        self._batch_spec = P(("dp",), "sp") if self.sp else None
 
         # ---- data first (its cardinality sizes the model head/vocab) ----
         dtype = _dtype_of(cfg.compute_dtype)
@@ -85,12 +100,20 @@ class Trainer:
         # key like num_classes/dtype overrides instead of raising a
         # duplicate-keyword TypeError) ----
         model_kw = {"num_classes": cfg.num_classes or card, "dtype": dtype}
-        if cfg.dnn.lower() in ("lstm", "transformer"):
+        if cfg.dnn.lower() in ("lstm", "transformer", "transformer_lm",
+                               "transformerlm"):
             model_kw["vocab_size"] = cfg.num_classes or card
         elif cfg.dnn.lower() == "lstman4":
             model_kw["num_labels"] = cfg.num_classes or card
         model_kw.update(cfg.model_kwargs)
+        if self.sp:
+            model_kw["sp_axis"] = "sp"
         self.spec = models_lib.get_model(cfg.dnn, cfg.dataset, **model_kw)
+        # mesh axis names only exist inside shard_map: initialize params via
+        # the sp-free twin (identical param structure)
+        init_module = (models_lib.get_model(
+            cfg.dnn, cfg.dataset, **{**model_kw, "sp_axis": None}).module
+            if self.sp else self.spec.module)
         self.steps_per_epoch = self.train_ds.steps_per_epoch
         self.total_steps = (cfg.max_steps if cfg.max_steps
                             else cfg.epochs * self.steps_per_epoch)
@@ -99,7 +122,7 @@ class Trainer:
         rng = jax.random.PRNGKey(cfg.seed)
         init_rng, self.data_rng, state_rng = jax.random.split(rng, 3)
         dummy = self._dummy_inputs()
-        variables = self.spec.module.init(
+        variables = init_module.init(
             {"params": init_rng, "dropout": init_rng}, *dummy, train=False)
         params = variables["params"]
         model_state = {k: v for k, v in variables.items() if k != "params"}
@@ -139,6 +162,7 @@ class Trainer:
             fold_lr=self.schedule if cfg.fold_lr else None,
             recurrent=self.recurrent,
             exchange=cfg.exchange,
+            sp_axis="sp" if self.sp else None,
         )
         carry = (self.spec.module.initial_carry(local_bs)
                  if self.recurrent else ())
@@ -159,8 +183,9 @@ class Trainer:
             sums = jax.tree.map(lambda x: jax.lax.psum(x, axes), sums)
             return (sums, new_carry) if self.recurrent else sums
 
-        in_specs = (P(), P(), P(axes)) + ((P(axes),) if self.recurrent
-                                          else ())
+        batch_in = self._batch_spec if self.sp else P(axes)
+        in_specs = (P(), P(), batch_in) + ((P(axes),) if self.recurrent
+                                           else ())
         out_specs = (P(), P(axes)) if self.recurrent else P()
         self.eval_step = jax.jit(jax.shard_map(
             eval_step, mesh=self.mesh,
@@ -231,7 +256,7 @@ class Trainer:
                                      os.path.join(self.run_dir, "profile"))
             self.timers.start("io")
             batch = next(it)
-            batch = shard_batch(self.mesh, batch)
+            batch = shard_batch(self.mesh, batch, spec=self._batch_spec)
             self.timers.start("step")
             step = self.step if not hasattr(self, "_step_cache") else \
                 self._step_cache
@@ -317,7 +342,7 @@ class Trainer:
             if (self.cfg.eval_max_batches is not None
                     and i >= self.cfg.eval_max_batches):
                 break
-            batch = shard_batch(self.mesh, batch)
+            batch = shard_batch(self.mesh, batch, spec=self._batch_spec)
             if self.recurrent:
                 sums, carry = self.eval_step(
                     self.state.params, self.state.model_state, batch, carry)

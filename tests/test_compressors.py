@@ -42,13 +42,22 @@ def _check_ef_invariant(acc, res: CompressResult):
     assert len(np.unique(idx[nz])) == nz.sum()
 
 
+def _call(spec, acc, k, rng=None):
+    """Uniform invocation across stateless and stateful compressors
+    (stateful fns take a state scalar and return (result, new_state))."""
+    if spec.stateful:
+        res, _ = spec.fn(acc, k, jnp.float32(spec.init_state), rng)
+        return res
+    return spec.fn(acc, k, rng)
+
+
 @pytest.mark.parametrize("name", NAMES)
 def test_ef_mass_conservation(name):
     spec = get_compressor(name, density=0.01)
     acc = _acc(2048)
     k = k_for(acc.size, 0.01)
     rng = jax.random.PRNGKey(1) if spec.requires_rng else None
-    res = spec.fn(acc, k, rng)
+    res = _call(spec, acc, k, rng)
     want_k = acc.size if spec.out_k is None else spec.out_k(k)
     assert res.compressed.indices.shape == (want_k,)
     assert res.compressed.values.shape == (want_k,)
@@ -173,9 +182,9 @@ def test_compressors_jit_with_static_shapes(name):
     acc = _acc(1024)
     k = k_for(acc.size, 0.01)
     rng = jax.random.PRNGKey(0) if spec.requires_rng else None
-    jitted = jax.jit(spec.fn, static_argnums=(1,))
-    res = jitted(acc, k, rng)
-    res2 = spec.fn(acc, k, rng)
+    jitted = jax.jit(lambda a, r: _call(spec, a, k, r))
+    res = jitted(acc, rng)
+    res2 = _call(spec, acc, k, rng)
     np.testing.assert_allclose(res.compressed.values, res2.compressed.values,
                                rtol=1e-6)
     np.testing.assert_array_equal(res.compressed.indices,
