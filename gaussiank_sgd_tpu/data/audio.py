@@ -78,12 +78,18 @@ def log_spectrogram(samples: np.ndarray, rate: int = SAMPLE_RATE,
     if len(samples) < N_FFT:
         samples = np.pad(samples, (0, N_FFT - len(samples)))
     stride = int(SAMPLE_RATE * STRIDE_MS / 1000)
-    n_frames = 1 + (len(samples) - N_FFT) // stride
-    idx = (np.arange(N_FFT)[None, :]
-           + stride * np.arange(n_frames)[:, None])      # [T, n_fft]
-    frames = samples[idx] * np.hamming(N_FFT)[None, :]
-    spec = np.abs(np.fft.rfft(frames, axis=1))           # [T, N_FREQ]
-    feat = np.log1p(spec).T.astype(np.float32)           # [N_FREQ, T]
+    from . import native
+    if native.available():
+        # threaded C++ matrix-DFT featurizer (native/io_pipeline.cpp);
+        # parity with the numpy path is tested to f32 tolerance
+        feat = native.log_spectrogram(samples, N_FFT, stride)
+    else:
+        n_frames = 1 + (len(samples) - N_FFT) // stride
+        idx = (np.arange(N_FFT)[None, :]
+               + stride * np.arange(n_frames)[:, None])  # [T, n_fft]
+        frames = samples[idx] * np.hamming(N_FFT)[None, :]
+        spec = np.abs(np.fft.rfft(frames, axis=1))       # [T, N_FREQ]
+        feat = np.log1p(spec).T.astype(np.float32)       # [N_FREQ, T]
     if normalize:
         feat = (feat - feat.mean()) / (feat.std() + 1e-6)
     return feat

@@ -42,6 +42,34 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    """The cached .so predates the current source (e.g. a symbol was added)."""
+    src = os.path.join(_NATIVE_DIR, "io_pipeline.cpp")
+    try:
+        return os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Declare every entry point; raises AttributeError on a stale .so."""
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    lib.gk_assemble_batch.argtypes = [
+        u8p, i32p, i32p,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, f32p, f32p, f32p, i32p,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
+    lib.gk_assemble_batch.restype = None
+    lib.gk_shuffle_indices.argtypes = [i32p, ctypes.c_int, ctypes.c_uint64]
+    lib.gk_shuffle_indices.restype = None
+    lib.gk_log_spectrogram.argtypes = [f32p, ctypes.c_int, ctypes.c_int,
+                                       ctypes.c_int, f32p, ctypes.c_int]
+    lib.gk_log_spectrogram.restype = None
+    return lib
+
+
 def load() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native library; None if unavailable."""
     global _lib, _tried
@@ -49,26 +77,20 @@ def load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and not _build():
+        if ((not os.path.exists(_LIB_PATH) or _stale()) and not _build()):
             return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            return None
-        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
-        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
-        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
-        lib.gk_assemble_batch.argtypes = [
-            u8p, i32p, i32p,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, f32p, f32p, f32p, i32p,
-            ctypes.c_uint64, ctypes.c_int, ctypes.c_int]
-        lib.gk_assemble_batch.restype = None
-        lib.gk_shuffle_indices.argtypes = [i32p, ctypes.c_int,
-                                           ctypes.c_uint64]
-        lib.gk_shuffle_indices.restype = None
-        _lib = lib
-        return _lib
+        for attempt in range(2):
+            try:
+                _lib = _bind(ctypes.CDLL(_LIB_PATH))
+                return _lib
+            except OSError:
+                return None
+            except AttributeError:
+                # stale cached .so missing a newer symbol: rebuild once,
+                # then degrade to the numpy fallbacks (module contract)
+                if attempt or not _build():
+                    return None
+        return None
 
 
 def available() -> bool:
@@ -94,6 +116,23 @@ def assemble_batch(images_u8: np.ndarray, labels: np.ndarray,
         out_x, out_y, ctypes.c_uint64(seed & (2**64 - 1)),
         1 if augment else 0, nthreads)
     return out_x, out_y
+
+
+def log_spectrogram(samples: np.ndarray, n_fft: int, stride: int,
+                    nthreads: int = 4) -> np.ndarray:
+    """Native STFT log-magnitude features: [n_freq, n_frames] (un-normalized;
+    caller applies mean/std). Caller checks available()."""
+    lib = load()
+    assert lib is not None
+    samples = np.ascontiguousarray(samples, np.float32)
+    assert len(samples) >= n_fft, (
+        f"need >= n_fft={n_fft} samples, got {len(samples)} (pad first)")
+    n_freq = n_fft // 2 + 1
+    n_frames = 1 + (len(samples) - n_fft) // stride
+    out = np.empty((n_freq, n_frames), np.float32)
+    lib.gk_log_spectrogram(samples, len(samples), n_fft, stride, out,
+                           nthreads)
+    return out
 
 
 def shuffle_indices(n: int, seed: int) -> np.ndarray:

@@ -11,8 +11,11 @@
 // Determinism: per-image counter-based RNG (splitmix64 of seed ^ index),
 // so a batch is reproducible regardless of thread count or schedule.
 
+#include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <memory>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -101,6 +104,90 @@ void gk_assemble_batch(const uint8_t* images, const int32_t* labels,
     const int hi = lo + chunk < b ? lo + chunk : b;
     if (lo >= hi) break;
     ts.emplace_back([&j, lo, hi] { assemble_range(j, lo, hi); });
+  }
+  for (auto& t : ts) t.join();
+}
+
+// STFT log-magnitude features for the AN4 speech path (data/audio.py):
+// Hamming-windowed frames -> |DFT| (matrix DFT with precomputed twiddles;
+// n_fft is not a power of two, and at 51K MACs/frame a radix kernel buys
+// nothing) -> log1p. Thread-parallel over frames. Output [n_freq, n_frames]
+// row-major, matching the numpy featurizer bit-for-bit up to f32 rounding;
+// mean/std normalization stays in Python (one cheap pass).
+void gk_log_spectrogram(const float* samples, int n_samples, int n_fft,
+                        int stride, float* out, int nthreads) {
+  const int n_freq = n_fft / 2 + 1;
+  const int n_frames = 1 + (n_samples - n_fft) / stride;
+  if (n_frames <= 0) return;
+  // window + twiddle tables depend only on n_fft: cached across calls
+  // (featurization calls this once per utterance; rebuilding ~100K trig
+  // entries each time would rival the DFT work itself). Callers snapshot a
+  // shared_ptr so a concurrent call with a different n_fft can safely swap
+  // the cache without invalidating in-flight readers.
+  struct Tables {
+    std::vector<float> win, cosw, sinw;
+  };
+  static std::mutex tbl_mu;
+  static int cached_n_fft = -1;
+  static std::shared_ptr<const Tables> cached;
+  std::shared_ptr<const Tables> tbl;
+  {
+    std::lock_guard<std::mutex> g(tbl_mu);
+    if (cached_n_fft != n_fft) {
+      auto t = std::make_shared<Tables>();
+      const double pi = 3.14159265358979323846;
+      t->win.resize(n_fft);
+      t->cosw.resize(static_cast<size_t>(n_freq) * n_fft);
+      t->sinw.resize(static_cast<size_t>(n_freq) * n_fft);
+      for (int i = 0; i < n_fft; ++i)
+        t->win[i] = static_cast<float>(
+            0.54 - 0.46 * std::cos(2.0 * pi * i / (n_fft - 1)));
+      for (int f = 0; f < n_freq; ++f) {
+        for (int i = 0; i < n_fft; ++i) {
+          const double ang = -2.0 * pi * f * i / n_fft;
+          t->cosw[static_cast<size_t>(f) * n_fft + i] =
+              static_cast<float>(std::cos(ang));
+          t->sinw[static_cast<size_t>(f) * n_fft + i] =
+              static_cast<float>(std::sin(ang));
+        }
+      }
+      cached = t;
+      cached_n_fft = n_fft;
+    }
+    tbl = cached;
+  }
+  const std::vector<float>& win = tbl->win;
+  const std::vector<float>& cosw = tbl->cosw;
+  const std::vector<float>& sinw = tbl->sinw;
+  auto frames_range = [&](int lo, int hi) {
+    std::vector<float> buf(n_fft);
+    for (int t = lo; t < hi; ++t) {
+      const float* s = samples + static_cast<int64_t>(t) * stride;
+      for (int i = 0; i < n_fft; ++i) buf[i] = s[i] * win[i];
+      for (int f = 0; f < n_freq; ++f) {
+        const float* cw = &cosw[static_cast<size_t>(f) * n_fft];
+        const float* sw = &sinw[static_cast<size_t>(f) * n_fft];
+        float re = 0.0f, im = 0.0f;
+        for (int i = 0; i < n_fft; ++i) {
+          re += buf[i] * cw[i];
+          im += buf[i] * sw[i];
+        }
+        out[static_cast<int64_t>(f) * n_frames + t] =
+            std::log1p(std::sqrt(re * re + im * im));
+      }
+    }
+  };
+  if (nthreads <= 1 || n_frames < 2 * nthreads) {
+    frames_range(0, n_frames);
+    return;
+  }
+  std::vector<std::thread> ts;
+  const int chunk = (n_frames + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    const int lo = t * chunk;
+    const int hi = lo + chunk < n_frames ? lo + chunk : n_frames;
+    if (lo >= hi) break;
+    ts.emplace_back([&frames_range, lo, hi] { frames_range(lo, hi); });
   }
   for (auto& t : ts) t.join();
 }

@@ -110,6 +110,35 @@ def test_recurrent_restore_onto_different_mesh(tmp_path):
     assert np.isfinite(float(m.loss))
 
 
+def test_trainer_resume_with_different_worker_count(tmp_path):
+    """End-to-end elastic resume: train 8-way, checkpoint, resume the
+    Trainer 4-way from the same run dir, keep training."""
+    from gaussiank_sgd_tpu.training.checkpoint import save_checkpoint
+    from gaussiank_sgd_tpu.training.config import TrainConfig
+    from gaussiank_sgd_tpu.training.trainer import Trainer
+
+    base = dict(
+        dnn="mnistnet", dataset="mnist", batch_size=8, lr=0.01,
+        momentum=0.9, weight_decay=0.0, epochs=1, max_steps=12,
+        compressor="gaussian", density=0.01, compress_warmup_steps=2,
+        warmup_epochs=0.0, compute_dtype="float32",
+        output_dir=str(tmp_path), log_every=4, eval_every_epochs=0,
+        save_every_epochs=0, seed=0,
+    )
+    t8 = Trainer(TrainConfig(**base, nworkers=8))
+    t8.train(6)
+    ckpt = save_checkpoint(os.path.join(t8.run_dir, "ckpt"), t8.state)
+    t8.close()
+
+    t4 = Trainer(TrainConfig(**base, nworkers=4, run_id="resumed4",
+                             resume=os.path.dirname(ckpt)))
+    assert t4.step == 6
+    assert t4.state.ef_residual.shape[0] == 4
+    t4.train(3)
+    assert t4.step == 9
+    t4.close()
+
+
 def test_restore_same_mesh_keeps_rows(tmp_path):
     """P == P' must keep per-worker rows EXACTLY (no redistribution)."""
     ts8, s8, b8 = _problem(8)
