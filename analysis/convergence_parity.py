@@ -34,15 +34,17 @@ ARTIFACTS = os.path.join(REPO, "analysis", "artifacts")
 
 
 def run_arm(name, steps, density, outdir, **overrides):
+    """One training arm. Experiment-defining hyperparameters (dnn, dataset,
+    batch_size, lr, ...) come from the caller via ``overrides`` — main() is
+    the single source of their defaults (the argparse surface)."""
     import json as _json
 
     from gaussiank_sgd_tpu.training.config import TrainConfig
     from gaussiank_sgd_tpu.training.trainer import Trainer
 
     cfg = dict(
-        dnn="mnistnet", dataset="mnist", batch_size=8, nworkers=8,
-        lr=0.005, momentum=0.9, weight_decay=0.0, epochs=1, max_steps=steps,
-        compressor="gaussian", density=density, compress_warmup_steps=10,
+        momentum=0.9, epochs=1, max_steps=steps,
+        compressor="gaussian", density=density,
         warmup_epochs=0.0, compute_dtype="float32", output_dir=outdir,
         log_every=10, eval_every_epochs=0, save_every_epochs=0, seed=0,
         run_id=name,
@@ -57,12 +59,19 @@ def run_arm(name, steps, density, outdir, **overrides):
     t.close()
     return {
         "arm": name,
+        "compressor": cfg["compressor"],      # provenance: what actually ran
+        "exchange": cfg.get("exchange", "allgather"),
         "final_loss": tr[-1]["loss"],
         "val_loss": res["val_loss"],
         "top1": res.get("top1"),
-        "bytes_per_step_sparse": tr[-1]["bytes_sent"],
+        # last-step exchange payload; the dense arm's value is its FULL
+        # dense gradient (no compression)
+        "bytes_per_step": tr[-1]["bytes_sent"],
         "curve": [(r["step"], r["loss"]) for r in tr],
     }
+
+
+DEFAULT_ARMS = "none,gaussian,topk,gaussian@gtopk"
 
 
 def main(argv=None):
@@ -70,6 +79,20 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=300)
     p.add_argument("--density", type=float, default=0.01)
     p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--dnn", default="mnistnet")
+    p.add_argument("--dataset", default="mnist")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.005)
+    p.add_argument("--weight-decay", type=float, default=0.0)
+    p.add_argument("--compress-warmup-steps", type=int, default=10)
+    p.add_argument("--arms", default=DEFAULT_ARMS,
+                   help="comma list of compressor[@exchange]; 'none' = the "
+                        "dense baseline arm")
+    p.add_argument("--data-dir", dest="data_dir", default=None,
+                   help="real dataset files (default: synthetic stand-in)")
+    p.add_argument("--tag", default=None,
+                   help="artifact suffix (default: the dnn when not "
+                        "mnistnet)")
     p.add_argument("--outdir", default="/tmp/gksgd_parity")
     args = p.parse_args(argv)
 
@@ -77,41 +100,70 @@ def main(argv=None):
     virtual_cpu.enable_compile_cache()
     os.makedirs(ARTIFACTS, exist_ok=True)
 
-    arms = [
-        ("dense", dict(compressor="none")),
-        ("gaussian_allgather", dict(compressor="gaussian")),
-        ("topk_allgather", dict(compressor="topk")),
-        ("gaussian_gtopk", dict(compressor="gaussian", exchange="gtopk")),
-    ]
+    common = dict(dnn=args.dnn, dataset=args.dataset,
+                  batch_size=args.batch_size, lr=args.lr,
+                  weight_decay=args.weight_decay, nworkers=args.devices,
+                  data_dir=args.data_dir,
+                  compress_warmup_steps=args.compress_warmup_steps)
+    from gaussiank_sgd_tpu.compressors import NAMES as COMP_NAMES
+    arms = []
+    for spec_str in args.arms.split(","):
+        comp, _, exch = spec_str.strip().partition("@")
+        if comp not in COMP_NAMES:
+            p.error(f"bad arm spec {spec_str!r}: compressor must be one of "
+                    f"{COMP_NAMES}")
+        name = comp if comp != "none" else "dense"
+        ov = dict(compressor=comp)
+        if exch:
+            name += f"_{exch}"
+            ov["exchange"] = exch
+        arms.append((name, ov))
     results = []
     for name, ov in arms:
         print(f"=== arm {name} ===", flush=True)
         results.append(run_arm(name, args.steps, args.density,
-                               args.outdir, **ov))
+                               args.outdir, **common, **ov))
         r = results[-1]
         print(f"{name}: final_loss={r['final_loss']:.4f} "
-              f"val_loss={r['val_loss']:.4f} top1={r['top1']:.4f} "
-              f"bytes/step={r['bytes_per_step_sparse']}", flush=True)
+              f"val_loss={r['val_loss']:.4f} top1={r['top1']} "
+              f"bytes/step={r['bytes_per_step']}", flush=True)
 
-    dense = next(r for r in results if r["arm"] == "dense")
+    dense = next((r for r in results if r["compressor"] == "none"), None)
     summary = {
         "config": {"steps": args.steps, "density": args.density,
-                   "nworkers": args.devices, "model": "mnistnet",
-                   "dataset": "mnist(synthetic)"},
+                   "nworkers": args.devices, "model": args.dnn,
+                   "dataset": args.dataset + (
+                       f"(real: {args.data_dir})" if args.data_dir
+                       else "(synthetic)"),
+                   "reproduce": "python analysis/convergence_parity.py "
+                                f"--dnn {args.dnn} --dataset {args.dataset} "
+                                f"--steps {args.steps} --density "
+                                f"{args.density} --arms {args.arms} "
+                                f"--lr {args.lr} --batch-size "
+                                f"{args.batch_size} --weight-decay "
+                                f"{args.weight_decay} --devices "
+                                f"{args.devices} --compress-warmup-steps "
+                                f"{args.compress_warmup_steps}"},
         "arms": [{k: r[k] for k in
-                  ("arm", "final_loss", "val_loss", "top1",
-                   "bytes_per_step_sparse")} for r in results],
-        "parity": {
+                  ("arm", "compressor", "exchange", "final_loss",
+                   "val_loss", "top1", "bytes_per_step")} for r in results],
+    }
+    if dense is not None:   # a parity block only makes sense vs a dense arm
+        summary["parity"] = {
             r["arm"]: {
-                "top1_gap_vs_dense": round(dense["top1"] - r["top1"], 4),
+                "top1_gap_vs_dense": (round(dense["top1"] - r["top1"], 4)
+                                      if r["top1"] is not None else None),
                 "val_loss_ratio_vs_dense":
                     round(r["val_loss"] / dense["val_loss"], 4),
-            } for r in results if r["arm"] != "dense"
-        },
-    }
-    with open(os.path.join(ARTIFACTS, "convergence_parity.json"), "w") as f:
+            } for r in results if r is not dense
+        }
+    tag = args.tag if args.tag is not None else (
+        "" if args.dnn == "mnistnet" else f"_{args.dnn}")
+    with open(os.path.join(ARTIFACTS,
+                           f"convergence_parity{tag}.json"), "w") as f:
         json.dump(summary, f, indent=2)
-    with open(os.path.join(ARTIFACTS, "convergence_parity_curves.jsonl"),
+    with open(os.path.join(ARTIFACTS,
+                           f"convergence_parity{tag}_curves.jsonl"),
               "w") as f:
         for r in results:
             f.write(json.dumps({"arm": r["arm"], "curve": r["curve"]}) + "\n")
@@ -124,14 +176,14 @@ def main(argv=None):
             xs, ys = zip(*r["curve"])
             ax.plot(xs, ys, label=r["arm"])
         ax.set_xlabel("step"); ax.set_ylabel("train loss")
-        ax.set_title(f"compressed vs dense DP, density={args.density}, "
-                     f"{args.devices}-way")
+        ax.set_title(f"{args.dnn}: compressed vs dense DP, "
+                     f"density={args.density}, {args.devices}-way")
         ax.legend(); fig.tight_layout()
-        fig.savefig(os.path.join(ARTIFACTS, "convergence_parity.png"),
-                    dpi=120)
+        fig.savefig(os.path.join(ARTIFACTS,
+                                 f"convergence_parity{tag}.png"), dpi=120)
     except Exception as e:  # matplotlib optional on this machine
         print(f"(no plot: {e})")
-    print(json.dumps(summary["parity"], indent=2))
+    print(json.dumps(summary.get("parity", summary["arms"]), indent=2))
     return summary
 
 

@@ -76,8 +76,10 @@ def get_model(dnn: str, dataset: Optional[str] = None, *,
         return ModelSpec("lstman4", m, (161, 200), jnp.float32, labels, "ctc")
     if dnn == "transformer":  # BASELINE config 5 (new target, no ref model)
         vocab = kw.pop("vocab_size", 32000)
+        seq_len = kw.pop("seq_len", 64)
         m = Transformer(vocab_size=vocab, dtype=dtype, **kw)
-        return ModelSpec("transformer", m, (64,), jnp.int32, vocab, "seq2seq")
+        return ModelSpec("transformer", m, (seq_len,), jnp.int32, vocab,
+                         "seq2seq")
     if dnn in ("transformer_lm", "transformerlm"):
         # decoder-only LM with optional ring-attention sequence parallelism
         # (long-context path; models/transformer_lm.py)

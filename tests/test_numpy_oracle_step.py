@@ -1,0 +1,136 @@
+"""End-to-end NumPy oracle of the SURVEY.md §2.3 update rule (test plan
+item (c)): simulate P workers in pure numpy — per-worker EF accumulate,
+exact top-k select, allgather, scatter-sum-average, SGD — and require the
+fused SPMD sparse step to reproduce it bit-for-bit (f32 tolerance) over
+several steps, including the EF residual trajectories.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from gaussiank_sgd_tpu.compressors import get_compressor
+from gaussiank_sgd_tpu.parallel.bucketing import make_bucket_plan
+from gaussiank_sgd_tpu.parallel.mesh import data_parallel_mesh, shard_batch
+from gaussiank_sgd_tpu.parallel.trainstep import build_dp_train_step
+
+PW, DIM, K_DENSITY = 8, 24, 0.25   # workers, params, density
+
+
+def _quadratic_problem():
+    """loss = 0.5 * mean_i ||w - x_i||^2 — grad per worker = w - mean(x_w).
+
+    Linear in w, so grads depend only on params (deterministic, no rng),
+    making the numpy simulation exact.
+    """
+    rng = np.random.default_rng(0)
+    data = rng.normal(0.0, 1.0, size=(PW * 2, DIM)).astype(np.float32)
+    w0 = rng.normal(0.0, 1.0, size=(DIM,)).astype(np.float32)
+
+    def loss_fn(params, mstate, batch, _rng):
+        x = batch[0]
+        d = params["w"] - x
+        return 0.5 * jnp.mean(jnp.sum(d * d, axis=-1)), (mstate, {})
+
+    return data, w0, loss_fn
+
+
+def _numpy_sim(data, w0, lr, steps, k):
+    """The reference's exact update rule (SURVEY.md §2.3), numpy."""
+    w = w0.copy()
+    residual = np.zeros((PW, DIM), np.float32)
+    shards = data.reshape(PW, -1, DIM)
+    traj = []
+    for _ in range(steps):
+        packed = []
+        for p in range(PW):
+            g = w - shards[p].mean(axis=0)            # local grad
+            acc = residual[p] + g                     # EF accumulate
+            idx = np.argsort(-np.abs(acc), kind="stable")[:k]
+            vals = acc[idx]
+            residual[p] = acc
+            residual[p][idx] = 0.0                    # keep un-sent mass
+            packed.append((idx, vals))
+        dense = np.zeros(DIM, np.float32)
+        for idx, vals in packed:                      # allgather + sum
+            np.add.at(dense, idx, vals)
+        w = w - lr * dense / PW                       # averaged SGD
+        traj.append(w.copy())
+    return w, residual, traj
+
+
+def test_spmd_step_matches_numpy_oracle():
+    data, w0, loss_fn = _quadratic_problem()
+    lr, steps = 0.3, 5
+    k = max(1, int(np.ceil(K_DENSITY * DIM)))
+
+    mesh = data_parallel_mesh(PW)
+    comp = get_compressor("topk", density=K_DENSITY)
+    plan = make_bucket_plan([DIM], K_DENSITY)
+    ts = build_dp_train_step(loss_fn, optax.sgd(lr), comp, plan, mesh)
+    state = ts.init_state({"w": jnp.asarray(w0)}, jax.random.PRNGKey(0))
+    batch = shard_batch(mesh, (jnp.asarray(data),))
+
+    w_ref, res_ref, traj = _numpy_sim(data, w0, lr, steps, k)
+    for s in range(steps):
+        state, m = ts.sparse_step(state, batch)
+        np.testing.assert_allclose(np.asarray(state.params["w"]), traj[s],
+                                   rtol=2e-5, atol=2e-6,
+                                   err_msg=f"step {s}")
+    # the per-worker EF residual trajectories match too
+    np.testing.assert_allclose(np.asarray(state.ef_residual), res_ref,
+                               rtol=2e-5, atol=2e-6)
+    # and the metrics report the exact sparse payload
+    assert int(m.bytes_sent) == k * 8
+
+
+def test_spmd_gtopk_step_matches_numpy_gtopk_oracle():
+    """Same oracle idea for the gTop-k exchange: global top-k of the summed
+    sparse contributions (the butterfly's fixed point, SURVEY.md §2.3)."""
+    data, w0, loss_fn = _quadratic_problem()
+    lr = 0.3
+    k = max(1, int(np.ceil(K_DENSITY * DIM)))
+
+    mesh = data_parallel_mesh(PW)
+    comp = get_compressor("topk", density=K_DENSITY)
+    plan = make_bucket_plan([DIM], K_DENSITY)
+    ts = build_dp_train_step(loss_fn, optax.sgd(lr), comp, plan, mesh,
+                             exchange="gtopk")
+    state = ts.init_state({"w": jnp.asarray(w0)}, jax.random.PRNGKey(0))
+    batch = shard_batch(mesh, (jnp.asarray(data),))
+
+    # one step by hand, simulating the XOR butterfly EXACTLY: per round,
+    # each worker exchanges its k-sparse set with rank^stride, sum-merges
+    # colliding indices, and re-selects top-k by |value| — entries small in
+    # early rounds can be dropped before their sum would matter, so this is
+    # NOT the idealized global top-k (parallel/gtopk.py docstring).
+    shards = data.reshape(PW, -1, DIM)
+    sets = []
+    for p in range(PW):
+        g = w0 - shards[p].mean(axis=0)
+        idx = np.argsort(-np.abs(g), kind="stable")[:k]
+        sets.append(dict(zip(idx.tolist(), g[idx].tolist())))
+
+    def merge(a, b):
+        m = dict(a)
+        for i, v in b.items():
+            m[i] = m.get(i, 0.0) + v
+        top = sorted(m.items(), key=lambda kv: (-abs(kv[1]), kv[0]))[:k]
+        return dict(top)
+
+    for r in range(int(np.log2(PW))):
+        stride = 1 << r
+        sets = [merge(sets[p], sets[p ^ stride]) for p in range(PW)]
+    # butterfly converges to the same set on every worker
+    assert all(s.keys() == sets[0].keys() for s in sets)
+    dense = np.zeros(DIM, np.float32)
+    for i, v in sets[0].items():
+        dense[i] = v
+    w_ref = w0 - lr * dense / PW
+
+    state, m = ts.sparse_step(state, batch)
+    np.testing.assert_allclose(np.asarray(state.params["w"]), w_ref,
+                               rtol=2e-5, atol=2e-6)

@@ -129,6 +129,39 @@ def test_trainer_ptb_lstm(tmp_path):
     t.close()
 
 
+def test_trainer_transformer_wmt(tmp_path):
+    """BASELINE config 5 shape (toy): seq2seq transformer on the synthetic
+    copy-reverse WMT stand-in with RandomK-EC compression."""
+    t = Trainer(make_cfg(tmp_path, dnn="transformer", dataset="wmt",
+                         batch_size=2, nworkers=8, compressor="randomkec",
+                         density=0.01, max_steps=4, compress_warmup_steps=2,
+                         clip_norm=1.0, label_smoothing=0.1,
+                         model_kwargs=dict(dim=32, heads=2, enc_layers=1,
+                                           dec_layers=1, ffn=64, dropout=0.0,
+                                           max_len=32, seq_len=16),
+                         dataset_kwargs=dict(vocab_size=64, src_len=16,
+                                             tgt_len=16,
+                                             synthetic_examples=128),
+                         eval_max_batches=2))
+    t.train(4)
+    res = t.test()
+    assert np.isfinite(res["val_loss"]) and 0.0 <= res["top1"] <= 1.0
+    t.close()
+
+
+def test_trainer_hierarchical_mesh(tmp_path):
+    """ici x dcn hierarchical DP through the full Trainer: the sparse
+    allgather rides the ici axis, dense partials psum over dcn."""
+    t = Trainer(make_cfg(tmp_path, nworkers=0, ici_size=4, dcn_size=2,
+                         max_steps=6, compress_warmup_steps=2))
+    assert tuple(t.mesh.axis_names) == ("dcn_dp", "ici_dp")
+    assert t.nworkers == 8
+    t.train(6)
+    res = t.test()
+    assert 0.0 <= res["top1"] <= 1.0
+    t.close()
+
+
 def test_trainer_warmup_switches_to_sparse(tmp_path):
     t = Trainer(make_cfg(tmp_path, max_steps=8, compress_warmup_steps=4,
                          log_every=1))
