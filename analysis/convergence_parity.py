@@ -112,6 +112,9 @@ def main(argv=None):
         if comp not in COMP_NAMES:
             p.error(f"bad arm spec {spec_str!r}: compressor must be one of "
                     f"{COMP_NAMES}")
+        if exch and exch not in ("allgather", "gtopk"):
+            p.error(f"bad arm spec {spec_str!r}: exchange must be "
+                    f"allgather or gtopk")
         name = comp if comp != "none" else "dense"
         ov = dict(compressor=comp)
         if exch:
@@ -135,15 +138,13 @@ def main(argv=None):
                    "dataset": args.dataset + (
                        f"(real: {args.data_dir})" if args.data_dir
                        else "(synthetic)"),
-                   "reproduce": "python analysis/convergence_parity.py "
-                                f"--dnn {args.dnn} --dataset {args.dataset} "
-                                f"--steps {args.steps} --density "
-                                f"{args.density} --arms {args.arms} "
-                                f"--lr {args.lr} --batch-size "
-                                f"{args.batch_size} --weight-decay "
-                                f"{args.weight_decay} --devices "
-                                f"{args.devices} --compress-warmup-steps "
-                                f"{args.compress_warmup_steps}"},
+                   # built from vars(args) so every flag that shaped the
+                   # run is recorded automatically
+                   "reproduce": "python analysis/convergence_parity.py " +
+                                " ".join(
+                       f"--{k.replace('_', '-')} {v}"
+                       for k, v in sorted(vars(args).items())
+                       if v not in (None, ""))},
         "arms": [{k: r[k] for k in
                   ("arm", "compressor", "exchange", "final_loss",
                    "val_loss", "top1", "bytes_per_step")} for r in results],
@@ -157,8 +158,8 @@ def main(argv=None):
                     round(r["val_loss"] / dense["val_loss"], 4),
             } for r in results if r is not dense
         }
-    tag = args.tag if args.tag is not None else (
-        "" if args.dnn == "mnistnet" else f"_{args.dnn}")
+    tag = (f"_{args.tag.lstrip('_')}" if args.tag else
+           ("" if args.dnn == "mnistnet" else f"_{args.dnn}"))
     with open(os.path.join(ARTIFACTS,
                            f"convergence_parity{tag}.json"), "w") as f:
         json.dump(summary, f, indent=2)
