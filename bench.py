@@ -28,7 +28,10 @@ def main():
     from gaussiank_sgd_tpu.benchlib import bench_model
 
     density = 0.001
-    compressors = ("approxtopk", "gaussian_warm", "gaussian")
+    # approxtopk (f32) stays in the sweep as the reference point for its
+    # bf16-ranking variant — the comparison BASELINE.md cites must stay
+    # reproducible and an approxtopk16 regression must stay visible
+    compressors = ("approxtopk16", "approxtopk", "gaussian_warm", "gaussian")
 
     times = bench_model("resnet20", "cifar10", 1024, density, compressors,
                         n_steps=40, rounds=8)

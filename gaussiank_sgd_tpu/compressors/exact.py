@@ -46,7 +46,8 @@ def topk_compress(acc: jax.Array, k: int,
 
 def approx_topk_compress(acc: jax.Array, k: int,
                          rng: Optional[jax.Array] = None,
-                         *, recall_target: float = 0.95) -> CompressResult:
+                         *, recall_target: float = 0.95,
+                         select_dtype=None) -> CompressResult:
     """Top-k via the TPU-native two-level select (``lax.approx_max_k``).
 
     The TPU-first answer to the reference's "exact top-k is too expensive on
@@ -58,9 +59,19 @@ def approx_topk_compress(acc: jax.Array, k: int,
     the error-feedback residual, so gradient mass is conserved exactly and
     convergence degrades gracefully (same argument as GaussianK's
     approximate selection in the reference).
+
+    ``select_dtype=bfloat16`` (the ``approxtopk16`` registry entry): only
+    the MAGNITUDE RANKING runs in bf16 — halving the select's HBM traffic.
+    The packed values gather from the f32 accumulator and the residual
+    update is exact, so the only effect is tie-reshuffling among entries
+    within one bf16 ulp — which EF absorbs by construction. Not the
+    default because ties make jit/eager selection order diverge (the
+    deterministic-reproducibility contract of the f32 path).
     """
-    _, idx = jax.lax.approx_max_k(jnp.abs(acc), k,
-                                  recall_target=recall_target)
+    mag = jnp.abs(acc)
+    if select_dtype is not None and acc.dtype != select_dtype:
+        mag = mag.astype(select_dtype)
+    _, idx = jax.lax.approx_max_k(mag, k, recall_target=recall_target)
     idx = idx.astype(jnp.int32)
     val = acc[idx]
     residual = acc.at[idx].set(0.0)

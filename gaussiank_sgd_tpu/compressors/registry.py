@@ -14,6 +14,8 @@ from __future__ import annotations
 import functools
 from typing import Callable, NamedTuple, Optional
 
+import jax.numpy as jnp
+
 from .base import CompressResult
 from .exact import approx_topk_compress, none_compress, topk_compress
 from .gaussian import gaussian_warm_compress, gaussiank_compress
@@ -58,6 +60,11 @@ def get_compressor(name: str, *, density: float = 0.001,
         # TPU-native flagship: hardware two-level select (see exact.py)
         return CompressorSpec("approxtopk", approx_topk_compress, False, True,
                               lambda k: k)
+    if name in ("approxtopk16", "approx_topk16"):
+        # bf16 magnitude ranking (half the select bandwidth; see exact.py)
+        fn = functools.partial(approx_topk_compress,
+                               select_dtype=jnp.bfloat16)
+        return CompressorSpec("approxtopk16", fn, False, True, lambda k: k)
     if name in ("gaussian", "gaussiank"):
         fn = functools.partial(gaussiank_compress, density=density,
                                sigma_scale=sigma_scale)
@@ -94,6 +101,6 @@ def get_compressor(name: str, *, density: float = 0.001,
     raise ValueError(f"unknown compressor {name!r}; known: {sorted(NAMES)}")
 
 
-NAMES = ("none", "topk", "approxtopk", "gaussian", "gaussian_warm",
-         "gaussian_pallas", "randomk", "randomkec", "dgcsampling",
-         "redsync", "redsynctrim")
+NAMES = ("none", "topk", "approxtopk", "approxtopk16", "gaussian",
+         "gaussian_warm", "gaussian_pallas", "randomk", "randomkec",
+         "dgcsampling", "redsync", "redsynctrim")

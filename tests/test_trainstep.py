@@ -90,10 +90,11 @@ def test_sparse_full_density_matches_dense():
                                rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("compressor", ["topk", "approxtopk", "gaussian",
-                                        "gaussian_warm", "gaussian_pallas",
-                                        "randomkec", "dgcsampling",
-                                        "redsync", "redsynctrim"])
+@pytest.mark.parametrize("compressor", ["topk", "approxtopk", "approxtopk16",
+                                        "gaussian", "gaussian_warm",
+                                        "gaussian_pallas", "randomkec",
+                                        "dgcsampling", "redsync",
+                                        "redsynctrim"])
 def test_sparse_step_converges(compressor):
     """EF-sparsified training at 10% density still optimizes (SURVEY §2.3).
 
