@@ -64,8 +64,11 @@ class PTBDataset:
 def make_ptb(data_dir: Optional[str] = None, split: str = "train",
              batch_size: int = 20, bptt: int = 35,
              vocab_size: int = 10000,
-             synthetic_tokens_n: int = 200_000) -> Tuple[PTBDataset, int]:
-    """Returns (dataset, vocab_size)."""
+             synthetic_tokens_n: int = 200_000,
+             synthetic_order: int = 1) -> Tuple[PTBDataset, int]:
+    """Returns (dataset, vocab_size). ``synthetic_order``: Markov order of
+    the offline stand-in stream (2 = cross-window dependencies, the carry
+    test setting — see synthetic.py)."""
     if data_dir and data_dir != "synthetic":
         train_path = os.path.join(data_dir, "ptb.train.txt")
         path = os.path.join(data_dir, f"ptb.{split}.txt")
@@ -74,5 +77,6 @@ def make_ptb(data_dir: Optional[str] = None, split: str = "train",
             toks = tokenize(path, vocab)
             return PTBDataset(toks, batch_size, bptt), len(vocab)
     toks = synthetic_tokens(synthetic_tokens_n, vocab_size,
-                            seed=0 if split == "train" else 1)
+                            seed=0 if split == "train" else 1,
+                            order=synthetic_order)
     return PTBDataset(toks, batch_size, bptt), vocab_size

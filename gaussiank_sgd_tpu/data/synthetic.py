@@ -46,20 +46,39 @@ def synthetic_tokens(num_tokens: int, vocab_size: int, seed: int = 0,
     The transition table (the TASK) comes from ``task_seed``, fixed across
     splits; ``seed`` drives the walk — train/valid streams share the chain,
     so validation perplexity on synthetic data is meaningful.
+
+    ``order``: Markov order. Order 1 needs only the previous token (fully
+    in-window context for any bptt — hidden-state carry cannot help).
+    Order 2 conditions on the previous TWO tokens, so the first prediction
+    of every bptt window depends on a token from the PREVIOUS window —
+    the controlled setting where carry ("repackaging") measurably lowers
+    perplexity.
     """
     task_rng = np.random.default_rng(task_seed)
-    # each state strongly prefers 4 successors -> low achievable perplexity
-    succ = task_rng.integers(0, vocab_size, size=(vocab_size, 4))
     rng = np.random.default_rng(seed)
     toks = np.empty(num_tokens, np.int32)
-    s = 0
     jumps = rng.random(num_tokens)
     picks = rng.integers(0, 4, size=num_tokens)
-    for i in range(num_tokens):
-        s = int(succ[s, picks[i]]) if jumps[i] > 0.1 else int(
-            rng.integers(0, vocab_size))
-        toks[i] = s
-    return toks
+    if order == 1:
+        # each state strongly prefers 4 successors -> low perplexity
+        succ = task_rng.integers(0, vocab_size, size=(vocab_size, 4))
+        s = 0
+        for i in range(num_tokens):
+            s = int(succ[s, picks[i]]) if jumps[i] > 0.1 else int(
+                rng.integers(0, vocab_size))
+            toks[i] = s
+        return toks
+    if order == 2:
+        succ = task_rng.integers(0, vocab_size,
+                                 size=(vocab_size, vocab_size, 4))
+        s2, s1 = 0, 0
+        for i in range(num_tokens):
+            s = int(succ[s2, s1, picks[i]]) if jumps[i] > 0.1 else int(
+                rng.integers(0, vocab_size))
+            s2, s1 = s1, s
+            toks[i] = s
+        return toks
+    raise ValueError(f"unsupported markov order {order}")
 
 
 def synthetic_seq2seq(num: int, src_len: int, tgt_len: int, vocab_size: int,
