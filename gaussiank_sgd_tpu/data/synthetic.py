@@ -69,6 +69,15 @@ def synthetic_tokens(num_tokens: int, vocab_size: int, seed: int = 0,
             toks[i] = s
         return toks
     if order == 2:
+        # the successor table is O(vocab^2): a dense (V, V, 4) array.
+        # Fine at the experiment scales this exists for (vocab <= 512 ->
+        # <= 8 MB); at make_ptb's default vocab 10000 it would be ~3 TB,
+        # so fail loudly instead of OOMing the host.
+        if vocab_size > 512:
+            raise ValueError(
+                f"order-2 synthetic stream needs vocab_size <= 512 (dense "
+                f"V^2 successor table); got {vocab_size} — pass a smaller "
+                f"vocab_size alongside synthetic_order=2")
         succ = task_rng.integers(0, vocab_size,
                                  size=(vocab_size, vocab_size, 4))
         s2, s1 = 0, 0
