@@ -18,7 +18,8 @@ import jax.numpy as jnp
 
 from .base import CompressResult
 from .exact import approx_topk_compress, none_compress, topk_compress
-from .gaussian import gaussian_warm_compress, gaussiank_compress
+from .gaussian import (gaussian_warm_compress, gaussian_warm_compress_batched,
+                       gaussiank_compress)
 from .randomk import randomk_compress, randomkec_compress
 from .sampling import dgc_compress, redsync_compress, redsynctrim_compress
 
@@ -39,6 +40,13 @@ class CompressorSpec(NamedTuple):
     # a per-worker [n_buckets] array in TrainState.comp_state.
     stateful: bool = False
     init_state: float = 0.0             # initial per-bucket state scalar
+    # Optional batched form for the vectorized uniform-bucket path:
+    # (x[n_chunks, chunk], k, state[n_chunks], rngs[n_chunks]) ->
+    # (batched CompressResult, new_state). Exists when a plain vmap of ``fn``
+    # would change the cost model (gaussian_warm: per-lane lax.cond lowers to
+    # select under vmap and runs BOTH branches — ADVICE r2 medium); the
+    # batched form hoists such decisions to scalar predicates.
+    batched_fn: Optional[Callable] = None
 
 
 def get_compressor(name: str, *, density: float = 0.001,
@@ -74,8 +82,10 @@ def get_compressor(name: str, *, density: float = 0.001,
         # compressor state, zero search passes in steady state (gaussian.py)
         fn = functools.partial(gaussian_warm_compress, density=density,
                                sigma_scale=sigma_scale)
+        bfn = functools.partial(gaussian_warm_compress_batched,
+                                density=density, sigma_scale=sigma_scale)
         return CompressorSpec("gaussian_warm", fn, False, True,
-                              lambda k: k, stateful=True)
+                              lambda k: k, stateful=True, batched_fn=bfn)
     if name in ("gaussian_pallas", "gaussianp"):
         # same selection contract as 'gaussian', threshold found by the
         # 3-pass Pallas kernel estimator (ops/pallas_select.py, SURVEY §7

@@ -208,8 +208,16 @@ def compress_buckets(spec: CompressorSpec, plan: BucketPlan, acc: jax.Array,
              if padded > acc.shape[0] else acc).reshape(n_chunks, chunk)
         st = (comp_state if spec.stateful
               else jnp.zeros((n_chunks,), jnp.float32))
-        rngs = jax.random.split(rng, n_chunks)
-        r, st_new = jax.vmap(lambda c, s, rg: call(c, k, s, rg))(x, st, rngs)
+        # per-bucket RNG derivation matches the unrolled path's fold_in(rng, i)
+        # exactly, so rng-consuming compressors (randomk/dgc) draw the same
+        # indices under either bucket policy (ADVICE r2 low)
+        rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+            jnp.arange(n_chunks, dtype=jnp.uint32))
+        if spec.batched_fn is not None:
+            r, st_new = spec.batched_fn(x, k, st, rngs)
+        else:
+            r, st_new = jax.vmap(lambda c, s, rg: call(c, k, s, rg))(
+                x, st, rngs)
         offs = (jnp.arange(n_chunks, dtype=jnp.int32) * chunk)[:, None]
         comp = CompressedGrad((r.compressed.indices + offs).reshape(-1),
                               r.compressed.values.reshape(-1))

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 
 # Honor the virtual-CPU hook BEFORE any jax import side effect: with
 # GKSGD_FORCE_VIRTUAL_CPU=<n> the CLI runs on an n-device virtual CPU mesh
@@ -43,6 +44,9 @@ from .training.trainer import Trainer
 
 
 def main(argv=None):
+    if argv is None:
+        argv = sys.argv[1:]     # pin what parse_args sees, so from_args's
+                                # explicit-flag detection re-reads the SAME list
     p = argparse.ArgumentParser(
         description="TPU-native communication-compressed data-parallel "
                     "training (GaussianK-SGD capability surface)")

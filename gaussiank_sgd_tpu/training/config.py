@@ -194,6 +194,15 @@ def from_args(args: argparse.Namespace,
     cfg_path = getattr(args, "config", None)
     if not cfg_path:
         return TrainConfig(**base)
+    if argv is None:
+        # Defaulting to sys.argv here would let a programmatic caller's
+        # process argv masquerade as explicit overrides of the config file
+        # (ADVICE r2). CLI callers pass the same argv they gave parse_args
+        # (train.py normalizes None -> sys.argv[1:] before parsing).
+        raise ValueError(
+            "--config precedence needs the original argv to tell explicit "
+            "flags from defaults; pass from_args(args, argv) the same list "
+            "parse_args saw (sys.argv[1:] for a CLI)")
     with open(cfg_path) as f:
         file_vals = json.load(f)
     # "_comment"-style annotation keys are documentation, not config

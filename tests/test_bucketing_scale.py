@@ -46,13 +46,12 @@ def test_uniform_matches_unrolled_loop(name):
     c_l, r_l, n_l, _ = compress_buckets(spec, loop, acc, rng)
     np.testing.assert_array_equal(np.asarray(r_u), np.asarray(r_l))
     assert int(n_u) == int(n_l)
-    if not spec.requires_rng:
-        # rng folding differs between paths, so indices compare only for
-        # deterministic compressors
-        np.testing.assert_array_equal(np.asarray(c_u.indices),
-                                      np.asarray(c_l.indices))
-        np.testing.assert_array_equal(np.asarray(c_u.values),
-                                      np.asarray(c_l.values))
+    # both paths derive per-bucket rng as fold_in(rng, i) (ADVICE r2), so
+    # rng-consuming compressors (randomkec) match across policies too
+    np.testing.assert_array_equal(np.asarray(c_u.indices),
+                                  np.asarray(c_l.indices))
+    np.testing.assert_array_equal(np.asarray(c_u.values),
+                                  np.asarray(c_l.values))
 
 
 @pytest.mark.parametrize("name", ["topk", "gaussian"])

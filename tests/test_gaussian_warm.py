@@ -63,6 +63,51 @@ def test_warm_ef_invariant():
                                np.asarray(acc * 1.01), rtol=1e-6, atol=1e-7)
 
 
+def test_batched_warm_matches_per_chunk():
+    """All-chunks-usable: the batched form == vmapped per-chunk warm path
+    (the ADVICE r2 fix must not change steady-state selection)."""
+    from gaussiank_sgd_tpu.compressors.gaussian import (
+        gaussian_warm_compress_batched)
+    n_chunks, chunk, k = 4, 2048, 32
+    x = jax.random.normal(jax.random.PRNGKey(3), (n_chunks, chunk))
+    # per-chunk thresholds near the true k-tail so every lane is usable
+    ts = jnp.asarray([float(jnp.sort(jnp.abs(xc))[-k - k // 4])
+                      for xc in x], jnp.float32)
+    rb, tb = gaussian_warm_compress_batched(x, k, ts, density=k / chunk)
+    for i in range(n_chunks):
+        ri, ti = gaussian_warm_compress(x[i], k, ts[i], density=k / chunk)
+        np.testing.assert_array_equal(np.asarray(rb.compressed.indices[i]),
+                                      np.asarray(ri.compressed.indices))
+        np.testing.assert_array_equal(np.asarray(rb.residual[i]),
+                                      np.asarray(ri.residual))
+        np.testing.assert_allclose(float(tb[i]), float(ti), rtol=1e-6)
+
+
+def test_batched_warm_cold_start():
+    """Zero state -> scalar cond takes the cold branch for every lane:
+    selection == stateless gaussian per chunk, and states become usable."""
+    from gaussiank_sgd_tpu.compressors.gaussian import (
+        gaussian_warm_compress_batched)
+    n_chunks, chunk, k = 3, 4096, 64
+    x = jax.random.normal(jax.random.PRNGKey(4), (n_chunks, chunk))
+    rb, tb = gaussian_warm_compress_batched(
+        x, k, jnp.zeros((n_chunks,), jnp.float32), density=k / chunk)
+    ref = get_compressor("gaussian", density=k / chunk)
+    for i in range(n_chunks):
+        ri = ref.fn(x[i], k)
+        np.testing.assert_array_equal(np.asarray(rb.compressed.indices[i]),
+                                      np.asarray(ri.compressed.indices))
+    assert np.all(np.asarray(tb) > 0)
+    # one warm follow-up keeps the EF invariant
+    r2, _ = gaussian_warm_compress_batched(x * 1.01, k, tb,
+                                           density=k / chunk)
+    for i in range(n_chunks):
+        sent = decompress(jax.tree.map(lambda a: a[i], r2.compressed), chunk)
+        np.testing.assert_allclose(np.asarray(sent + r2.residual[i]),
+                                   np.asarray(x[i] * 1.01),
+                                   rtol=1e-6, atol=1e-7)
+
+
 def _mlp_step(compressor, n_dev=8, density=0.05, bucket_size=None,
               policy="greedy"):
     import flax.linen as nn
