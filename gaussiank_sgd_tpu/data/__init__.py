@@ -126,7 +126,35 @@ def make_wmt(data_dir: Optional[str] = None, train: bool = True,
              batch_size: int = 64, src_len: int = 64, tgt_len: int = 64,
              vocab_size: int = 32000, seed: int = 0,
              synthetic_examples: int = 4096) -> Tuple[ArrayDataset, int]:
-    """WMT14-like seq2seq batches (BASELINE config 5); synthetic offline."""
+    """WMT14 En-De seq2seq batches (BASELINE config 5).
+
+    Real-data path (same contract as PTB/AN4): ``{data_dir}/{split}.en`` +
+    ``{split}.de`` parallel text, joint BPE vocab trained on the train split
+    (data/wmt.py). A partially-present dataset (some ``*.en/*.de`` exist but
+    not the requested split) fails loudly — silently mixing real and
+    synthetic text would make eval numbers meaningless. Fully absent ->
+    synthetic copy-reverse stand-in.
+    """
+    if data_dir and data_dir != "synthetic":
+        import glob
+        import os
+
+        from .wmt import load_wmt_corpus
+        split = "train" if train else "val"
+        en = os.path.join(data_dir, f"{split}.en")
+        de = os.path.join(data_dir, f"{split}.de")
+        if os.path.exists(en) and os.path.exists(de):
+            src, tgt, tok = load_wmt_corpus(data_dir, split, src_len,
+                                            tgt_len, vocab_size)
+            return (ArrayDataset((src, tgt), batch_size, shuffle=train,
+                                 seed=seed), tok.vocab_size)
+        other = [p for pat in ("*.en", "*.de")
+                 for p in glob.glob(os.path.join(data_dir, pat))]
+        if other:
+            raise FileNotFoundError(
+                f"{en} / {de} not found, but {sorted(other)} exist in "
+                f"{data_dir}; provide the {split} split (or use "
+                f"data_dir='synthetic' for the all-synthetic fallback)")
     src, tgt = synthetic_seq2seq(synthetic_examples, src_len, tgt_len,
                                  vocab_size, seed=0 if train else 1)
     return ArrayDataset((src, tgt), batch_size, shuffle=train, seed=seed), \
