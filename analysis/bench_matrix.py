@@ -30,16 +30,19 @@ sys.path.insert(0, REPO)
 
 ARTIFACTS = os.path.join(REPO, "analysis", "artifacts")
 
-# (config name, model, dataset, per-chip batch, model_kwargs, n_steps)
+# (config name, model, dataset, per-chip batch, model_kwargs, n_steps,
+#  bucket policy, bucket size) — the 20M+ LM configs use the uniform
+# 4M-chunk vmapped-selection plan (VERDICT r2 item 1, analysis/lm_fastpath.py)
 CONFIGS = [
-    ("config1_resnet20", "resnet20", "cifar10", 1024, {}, 40),
-    ("config2_vgg16", "vgg16", "cifar10", 256, {}, 20),
-    ("config3_resnet50", "resnet50", "imagenet", 64, {}, 10),
-    ("config4_lstm_ptb", "lstm", "ptb", 160, {}, 10),
-    ("config5_transformer", "transformer", "wmt", 64, {}, 10),
+    ("config1_resnet20", "resnet20", "cifar10", 1024, {}, 40, "greedy", None),
+    ("config2_vgg16", "vgg16", "cifar10", 256, {}, 20, "greedy", None),
+    ("config3_resnet50", "resnet50", "imagenet", 64, {}, 10, "greedy", None),
+    ("config4_lstm_ptb", "lstm", "ptb", 160, {}, 10, "uniform", 1 << 22),
+    ("config5_transformer", "transformer", "wmt", 64, {}, 10,
+     "uniform", 1 << 22),
 ]
 DENSITIES = (0.1, 0.01, 0.001)
-COMPRESSORS = ("approxtopk", "gaussian")
+COMPRESSORS = ("approxtopk", "gaussian", "gaussian_warm", "approxtopk16")
 
 
 def main(argv=None):
@@ -52,32 +55,40 @@ def main(argv=None):
 
     import jax
 
-    from gaussiank_sgd_tpu.benchlib import bench_model
+    from gaussiank_sgd_tpu.benchlib import bench_model, mfu
 
     densities = (0.001,) if args.quick else DENSITIES
     rounds = 3 if args.quick else 6
     os.makedirs(ARTIFACTS, exist_ok=True)
 
     results = []
-    for name, model, dataset, batch, mkw, n_steps in CONFIGS:
+    for name, model, dataset, batch, mkw, n_steps, policy, bsize in CONFIGS:
         if args.configs and not any(s in name for s in
                                     args.configs.split(",")):
             continue
         row = {"config": name, "model": model, "batch_per_chip": batch,
+               "bucket_policy": policy, "bucket_size": bsize,
                "platform": jax.devices()[0].platform, "cells": []}
         for d in densities:
             print(f"=== {name} density={d} ===", flush=True)
             times = bench_model(model, dataset, batch, d, COMPRESSORS,
                                 n_steps=n_steps, rounds=rounds,
-                                model_kwargs=mkw)
+                                model_kwargs=mkw, bucket_policy=policy,
+                                bucket_size=bsize)
             dense = times["dense"]
+            flops = times.get("_dense_step_flops")
+            peak = times.get("_peak_flops")
             for c in COMPRESSORS:
+                md, ms = mfu(flops, dense, peak), mfu(flops, times[c], peak)
                 row["cells"].append({
                     "density": d, "compressor": c,
                     "dense_ms": round(1e3 * dense, 3),
                     "sparse_ms": round(1e3 * times[c], 3),
                     "ratio": round(dense / times[c], 4),
                     "ex_per_s_chip": round(batch / times[c], 1),
+                    "flops_per_step": flops,
+                    "mfu_dense": round(md, 4) if md else None,
+                    "mfu_sparse": round(ms, 4) if ms else None,
                 })
             print(json.dumps(row["cells"][-len(COMPRESSORS):]), flush=True)
         results.append(row)
@@ -87,15 +98,17 @@ def main(argv=None):
             json.dump(results, f, indent=2)
 
     lines = ["| Config | density | compressor | dense ms | sparse ms | "
-             "sparse:dense | ex/s/chip |",
-             "|---|---|---|---|---|---|---|"]
+             "sparse:dense | ex/s/chip | MFU dense | MFU sparse |",
+             "|---|---|---|---|---|---|---|---|---|"]
     for row in results:
         for c in row["cells"]:
+            fmt = lambda v: f"{100 * v:.1f}%" if v else "—"
             lines.append(
                 f"| {row['config']} (b={row['batch_per_chip']}) "
                 f"| {c['density']} | {c['compressor']} | {c['dense_ms']} "
                 f"| {c['sparse_ms']} | {c['ratio']} "
-                f"| {c['ex_per_s_chip']} |")
+                f"| {c['ex_per_s_chip']} | {fmt(c['mfu_dense'])} "
+                f"| {fmt(c['mfu_sparse'])} |")
     table = "\n".join(lines)
     with open(os.path.join(ARTIFACTS, "bench_matrix.md"), "w") as f:
         f.write(table + "\n")

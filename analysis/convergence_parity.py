@@ -64,11 +64,23 @@ def run_arm(name, steps, density, outdir, **overrides):
         "final_loss": tr[-1]["loss"],
         "val_loss": res["val_loss"],
         "top1": res.get("top1"),
+        "perplexity": res.get("perplexity"),
         # last-step exchange payload; the dense arm's value is its FULL
         # dense gradient (no compression)
         "bytes_per_step": tr[-1]["bytes_sent"],
         "curve": [(r["step"], r["loss"]) for r in tr],
     }
+
+
+def _agg(vals):
+    """mean ± sample spread over seeds; None-safe."""
+    vals = [v for v in vals if v is not None]
+    if not vals:
+        return None
+    import numpy as np
+    return {"mean": round(float(np.mean(vals)), 4),
+            "std": round(float(np.std(vals)), 4),
+            "n": len(vals), "values": [round(float(v), 4) for v in vals]}
 
 
 DEFAULT_ARMS = "none,gaussian,topk,gaussian@gtopk"
@@ -88,6 +100,20 @@ def main(argv=None):
     p.add_argument("--arms", default=DEFAULT_ARMS,
                    help="comma list of compressor[@exchange]; 'none' = the "
                         "dense baseline arm")
+    p.add_argument("--seeds", type=int, default=1,
+                   help="run every arm with seeds 0..N-1 and report "
+                        "mean +/- std per arm (error bars, VERDICT r2 "
+                        "item 3)")
+    p.add_argument("--label-noise", dest="label_noise", type=float,
+                   default=0.0,
+                   help="symmetric label-flip fraction p: top-1 ceiling "
+                        "becomes 1-p, so the dense arm cannot saturate and "
+                        "a compression-induced gap is measurable")
+    p.add_argument("--model-kwargs", dest="model_kwargs", type=json.loads,
+                   default={}, help="JSON model ctor overrides (toy sizes)")
+    p.add_argument("--dataset-kwargs", dest="dataset_kwargs",
+                   type=json.loads, default={},
+                   help="JSON dataset overrides (e.g. bptt/vocab)")
     p.add_argument("--data-dir", dest="data_dir", default=None,
                    help="real dataset files (default: synthetic stand-in)")
     p.add_argument("--tag", default=None,
@@ -100,10 +126,15 @@ def main(argv=None):
     virtual_cpu.enable_compile_cache()
     os.makedirs(ARTIFACTS, exist_ok=True)
 
+    dataset_kwargs = dict(args.dataset_kwargs)
+    if args.label_noise > 0:
+        dataset_kwargs["label_noise"] = args.label_noise
     common = dict(dnn=args.dnn, dataset=args.dataset,
                   batch_size=args.batch_size, lr=args.lr,
                   weight_decay=args.weight_decay, nworkers=args.devices,
                   data_dir=args.data_dir,
+                  model_kwargs=args.model_kwargs,
+                  dataset_kwargs=dataset_kwargs,
                   compress_warmup_steps=args.compress_warmup_steps)
     from gaussiank_sgd_tpu.compressors import NAMES as COMP_NAMES
     arms = []
@@ -121,12 +152,24 @@ def main(argv=None):
             name += f"_{exch}"
             ov["exchange"] = exch
         arms.append((name, ov))
-    results = []
+    results = []          # one aggregated record per arm
     for name, ov in arms:
-        print(f"=== arm {name} ===", flush=True)
-        results.append(run_arm(name, args.steps, args.density,
-                               args.outdir, **common, **ov))
-        r = results[-1]
+        runs = []
+        for s in range(args.seeds):
+            print(f"=== arm {name} seed {s} ===", flush=True)
+            dkw = dict(common["dataset_kwargs"], seed=100 + s)
+            runs.append(run_arm(
+                f"{name}_s{s}", args.steps, args.density, args.outdir,
+                **{**common, "dataset_kwargs": dkw}, **ov, seed=s))
+        r = dict(runs[0])                       # arm metadata + seed-0 curve
+        r["arm"] = name
+        r["seed_runs"] = [{k: run[k] for k in
+                           ("final_loss", "val_loss", "top1", "perplexity")}
+                          for run in runs]
+        for key in ("final_loss", "val_loss", "top1", "perplexity"):
+            r[key + "_agg"] = _agg([run[key] for run in runs])
+            r[key] = r[key + "_agg"]["mean"] if r[key + "_agg"] else None
+        results.append(r)
         print(f"{name}: final_loss={r['final_loss']:.4f} "
               f"val_loss={r['val_loss']:.4f} top1={r['top1']} "
               f"bytes/step={r['bytes_per_step']}", flush=True)
@@ -135,6 +178,7 @@ def main(argv=None):
     summary = {
         "config": {"steps": args.steps, "density": args.density,
                    "nworkers": args.devices, "model": args.dnn,
+                   "seeds": args.seeds, "label_noise": args.label_noise,
                    "dataset": args.dataset + (
                        f"(real: {args.data_dir})" if args.data_dir
                        else "(synthetic)"),
@@ -142,20 +186,35 @@ def main(argv=None):
                    # run is recorded automatically
                    "reproduce": "python analysis/convergence_parity.py " +
                                 " ".join(
-                       f"--{k.replace('_', '-')} {v}"
+                       f"--{k.replace('_', '-')} "
+                       f"{json.dumps(v) if isinstance(v, dict) else v}"
                        for k, v in sorted(vars(args).items())
-                       if v not in (None, ""))},
-        "arms": [{k: r[k] for k in
+                       if v not in (None, "") and v != {})},
+        "arms": [{k: r.get(k) for k in
                   ("arm", "compressor", "exchange", "final_loss",
-                   "val_loss", "top1", "bytes_per_step")} for r in results],
+                   "val_loss", "top1", "perplexity", "bytes_per_step",
+                   "final_loss_agg", "val_loss_agg", "top1_agg",
+                   "perplexity_agg")} for r in results],
     }
     if dense is not None:   # a parity block only makes sense vs a dense arm
+        def paired_gap(r, key, rel=False):
+            """Seed-paired gap (dense_s - arm_s): level variation across
+            seeds cancels, leaving the compression effect ± its spread."""
+            gaps = []
+            for da, ra in zip(dense["seed_runs"], r["seed_runs"]):
+                if da[key] is None or ra[key] is None:
+                    continue
+                gaps.append((ra[key] / da[key]) if rel
+                            else (da[key] - ra[key]))
+            return _agg(gaps)
+
         summary["parity"] = {
             r["arm"]: {
-                "top1_gap_vs_dense": (round(dense["top1"] - r["top1"], 4)
-                                      if r["top1"] is not None else None),
-                "val_loss_ratio_vs_dense":
-                    round(r["val_loss"] / dense["val_loss"], 4),
+                "top1_gap_vs_dense": paired_gap(r, "top1"),
+                "val_loss_ratio_vs_dense": paired_gap(r, "val_loss",
+                                                      rel=True),
+                "perplexity_ratio_vs_dense": paired_gap(r, "perplexity",
+                                                        rel=True),
             } for r in results if r is not dense
         }
     tag = (f"_{args.tag.lstrip('_')}" if args.tag else

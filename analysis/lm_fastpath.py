@@ -1,0 +1,87 @@
+"""Configs 4/5 fast-path experiment (VERDICT r2 item 1).
+
+Measures, at the contract density 0.001, the sparse:dense step ratio for
+the LM configs that missed the >=0.90 target in r2 — LSTM/PTB (~20M params,
+best 0.82) and Transformer/WMT (~57M, best 0.70) — across the candidate
+fast-path lineup:
+
+  selector  x  bucket policy {whole-model, uniform 4M-chunk vmapped}
+
+Every (config, policy) cell is ONE interleaved bench_model run (dense +
+all selectors rotated within the run), so ratios are drift-robust; cells
+from different runs are not compared (BASELINE.md "How to read the matrix").
+
+Run on the TPU box:  python analysis/lm_fastpath.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ARTIFACTS = os.path.join(REPO, "analysis", "artifacts")
+
+CONFIGS = [
+    ("config4_lstm_ptb", "lstm", "ptb", 160, 10),
+    ("config5_transformer", "transformer", "wmt", 64, 10),
+]
+SELECTORS = ("approxtopk", "approxtopk16", "gaussian_warm")
+POLICIES = [
+    ("whole", "greedy", None),
+    ("uniform4M", "uniform", 1 << 22),
+]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--configs", default=None)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from gaussiank_sgd_tpu.benchlib import bench_model, mfu
+
+    rounds = 2 if args.quick else 4
+    density = 0.001
+    os.makedirs(ARTIFACTS, exist_ok=True)
+    out_path = os.path.join(ARTIFACTS, "lm_fastpath.json")
+
+    results = []
+    for name, model, dataset, batch, n_steps in CONFIGS:
+        if args.configs and args.configs not in name:
+            continue
+        for pol_name, policy, bsize in POLICIES:
+            print(f"=== {name} {pol_name} ===", flush=True)
+            times = bench_model(model, dataset, batch, density, SELECTORS,
+                                n_steps=n_steps, rounds=rounds,
+                                bucket_policy=policy, bucket_size=bsize)
+            dense = times["dense"]
+            flops = times.get("_dense_step_flops")
+            peak = times.get("_peak_flops")
+            md = mfu(flops, dense, peak)
+            cell = {"config": name, "policy": pol_name, "density": density,
+                    "dense_ms": round(1e3 * dense, 3),
+                    "mfu_dense": round(md, 4) if md else None,
+                    "selectors": {
+                        c: {"sparse_ms": round(1e3 * times[c], 3),
+                            "ratio": round(dense / times[c], 4),
+                            "mfu": (lambda m: round(m, 4) if m else None)(
+                                mfu(flops, times[c], peak))}
+                        for c in SELECTORS},
+                    "platform": jax.devices()[0].platform}
+            results.append(cell)
+            print(json.dumps(cell), flush=True)
+            with open(out_path, "w") as f:
+                json.dump(results, f, indent=2)
+    print("wrote", out_path)
+    return results
+
+
+if __name__ == "__main__":
+    main()

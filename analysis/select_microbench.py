@@ -1,0 +1,155 @@
+"""Microbenchmark: where does the sparse-step overhead go at LM scales?
+
+VERDICT r2 item 1: configs 4 (LSTM, ~20M params) and 5 (Transformer, ~57M)
+miss the >=0.90 sparse:dense target at density 0.001. This script times each
+candidate selection pipeline IN ISOLATION on the real chip at those buffer
+sizes, so the fast-path design (uniform chunks + vmapped selection + bf16
+ranking + warm thresholds) is driven by measurement, not guesswork.
+
+Methodology: single-dispatch timings are meaningless through the TPU tunnel
+(benchlib.py), so every variant runs N iterations inside ONE jitted
+``fori_loop``, chained through the EF residual (``acc' = residual +
+0.1*base`` — the steady-state error-feedback recurrence), and the whole
+dispatch is fenced once. Reported per-iteration ms.
+
+Timed variants (all end-to-end: acc -> packed (idx, val) + residual):
+  approxtopk        one approx_max_k over the whole flat buffer (f32 mag)
+  approxtopk16      same, bf16 magnitude ranking
+  gaussian          mean/std + 10-pass bisection + mask-pack
+  warm              threshold mask + pack (gaussian_warm steady state)
+  *_c<M>            same selector vmapped over uniform chunks of M elements
+
+Run on the TPU box:  python analysis/select_microbench.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gaussiank_sgd_tpu.compressors import get_compressor
+from gaussiank_sgd_tpu.compressors.gaussian import (
+    gaussian_warm_compress, gaussian_warm_compress_batched)
+
+N_ITERS = 20
+REPS = 3
+
+
+def timeit_loop(select_fn, acc, state0=None):
+    """Time ``select_fn(acc, state) -> (residual, new_state)`` chained
+    N_ITERS times in one jitted fori_loop dispatch; min-over-REPS ms/iter."""
+    base = acc
+
+    def body(_, carry):
+        a, st = carry
+        residual, st = select_fn(a, st)
+        return residual + 0.1 * base, st
+
+    @jax.jit
+    def run(a, st):
+        return lax.fori_loop(0, N_ITERS, body, (a, st))
+
+    st0 = jnp.float32(0) if state0 is None else state0
+    out = run(acc, st0)
+    jax.block_until_ready(out)                      # compile + warm
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = run(acc, st0)
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / N_ITERS)
+    return best
+
+
+def chunked(acc, chunk):
+    n = acc.shape[0]
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+    x = jnp.pad(acc, (0, pad)) if pad else acc
+    return x.reshape(n_chunks, chunk), n_chunks
+
+
+def main():
+    density = 0.001
+    sizes = {"lstm20M": 20_000_000, "transformer57M": 57_000_000}
+    chunks = (1 << 22,)
+    results = {}
+    for label, n in sizes.items():
+        acc = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.float32)
+        k = max(1, int(density * n))
+        row = {}
+
+        def flat_variant(name):
+            spec = get_compressor(name, density=density)
+
+            def sel(a, st):
+                return spec.fn(a, k).residual, st
+
+            return timeit_loop(sel, acc)
+
+        for name in ("approxtopk", "approxtopk16", "gaussian"):
+            row[name] = flat_variant(name)
+            print(label, name, round(1e3 * row[name], 3), "ms", flush=True)
+
+        # steady-state warm path at full buffer: threshold carried as state
+        t_est = float(jnp.sort(jnp.abs(acc[: 1 << 20]))[-(1 << 20) // 1000])
+        warm_fn = functools.partial(gaussian_warm_compress, density=density)
+
+        def warm_sel(a, st):
+            r, st = warm_fn(a, k, st)
+            return r.residual, st
+
+        row["warm"] = timeit_loop(warm_sel, acc, jnp.float32(t_est))
+        print(label, "warm", round(1e3 * row["warm"], 3), "ms", flush=True)
+
+        for chunk in chunks:
+            x, n_chunks = chunked(acc, chunk)
+            kc = max(1, int(density * chunk))
+            for name in ("approxtopk16",):
+                spec = get_compressor(name, density=density)
+
+                def sel(a, st, spec=spec, kc=kc):
+                    return jax.vmap(
+                        lambda c: spec.fn(c, kc).residual)(a), st
+
+                key = f"{name}_c{chunk >> 20}M"
+                row[key] = timeit_loop(sel, x)
+                print(label, key, round(1e3 * row[key], 3), "ms", flush=True)
+            bfn = functools.partial(gaussian_warm_compress_batched,
+                                    density=density)
+
+            def bsel(a, st, kc=kc):
+                r, st = bfn(a, kc, st)
+                return r.residual, st
+
+            st0 = jnp.full((n_chunks,), t_est, jnp.float32)
+            key = f"warm_c{chunk >> 20}M"
+            row[key] = timeit_loop(bsel, x, st0)
+            print(label, key, round(1e3 * row[key], 3), "ms", flush=True)
+
+        results[label] = {kk: round(1e3 * v, 3) for kk, v in row.items()}
+        print(label, json.dumps(results[label], indent=2), flush=True)
+
+    out = os.path.join(REPO, "analysis", "artifacts",
+                       "select_microbench.json")
+    with open(out, "w") as f:
+        json.dump({"density": density, "n_iters": N_ITERS,
+                   "methodology": "N-iter fori_loop per dispatch, chained "
+                                  "via EF residual, min over reps",
+                   "platform": jax.devices()[0].platform,
+                   "ms_per_iter": results}, f, indent=2)
+    print("wrote", out)
+
+
+if __name__ == "__main__":
+    main()

@@ -16,7 +16,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from .loader import ArrayDataset
-from .synthetic import synthetic_images
+from .synthetic import flip_labels, synthetic_images
 
 # standard CIFAR-10 channel stats
 _MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
@@ -114,8 +114,11 @@ def make_cifar(dataset: str = "cifar10", data_dir: Optional[str] = None,
                train: bool = True, batch_size: int = 128,
                augment: bool = True, seed: int = 0,
                synthetic_examples: int = 2048,
-               use_native: bool = True) -> Tuple[ArrayDataset, int]:
-    """Returns (dataset, num_classes)."""
+               use_native: bool = True,
+               label_noise: float = 0.0) -> Tuple[ArrayDataset, int]:
+    """Returns (dataset, num_classes). ``label_noise``: symmetric label-flip
+    fraction applied to BOTH splits (synthetic.flip_labels) — makes the
+    top-1 ceiling 1-p so convergence-parity experiments can fail."""
     from . import native
     num_classes = 100 if dataset == "cifar100" else 10
     x = x_u8 = None
@@ -127,6 +130,7 @@ def make_cifar(dataset: str = "cifar10", data_dir: Optional[str] = None,
         except FileNotFoundError:
             x_u8 = None
     if x_u8 is not None:
+        y = flip_labels(y, num_classes, label_noise, seed=0 if train else 1)
         if use_native and native.available():
             return CifarPipeline(x_u8, y, batch_size, shuffle=train,
                                  augment=train and augment,
@@ -135,6 +139,7 @@ def make_cifar(dataset: str = "cifar10", data_dir: Optional[str] = None,
     if x is None:
         x, y = synthetic_images(synthetic_examples, (32, 32, 3), num_classes,
                                 seed=0 if train else 1)
+        y = flip_labels(y, num_classes, label_noise, seed=0 if train else 1)
     aug = _augment(np.random.default_rng(seed)) if (train and augment) else None
     ds = ArrayDataset((x, y), batch_size, shuffle=train, seed=seed,
                       augment=aug)
@@ -143,8 +148,10 @@ def make_cifar(dataset: str = "cifar10", data_dir: Optional[str] = None,
 
 def make_mnist(data_dir: Optional[str] = None, train: bool = True,
                batch_size: int = 128, seed: int = 0,
-               synthetic_examples: int = 2048) -> Tuple[ArrayDataset, int]:
-    """MNIST via idx files if present, else synthetic (SURVEY.md §2 C7)."""
+               synthetic_examples: int = 2048,
+               label_noise: float = 0.0) -> Tuple[ArrayDataset, int]:
+    """MNIST via idx files if present, else synthetic (SURVEY.md §2 C7).
+    ``label_noise``: see make_cifar."""
     x = None
     if data_dir and data_dir != "synthetic":
         try:
@@ -160,4 +167,5 @@ def make_mnist(data_dir: Optional[str] = None, train: bool = True,
     if x is None:
         x, y = synthetic_images(synthetic_examples, (28, 28, 1), 10,
                                 seed=0 if train else 1)
+    y = flip_labels(y, 10, label_noise, seed=0 if train else 1)
     return ArrayDataset((x, y), batch_size, shuffle=train, seed=seed), 10

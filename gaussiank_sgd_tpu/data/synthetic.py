@@ -90,6 +90,25 @@ def synthetic_tokens(num_tokens: int, vocab_size: int, seed: int = 0,
     raise ValueError(f"unsupported markov order {order}")
 
 
+def flip_labels(y: np.ndarray, num_classes: int, fraction: float,
+                seed: int = 0) -> np.ndarray:
+    """Symmetric label noise: flip ``fraction`` of labels to a uniformly
+    random DIFFERENT class (seeded, reproducible).
+
+    This is the convergence-evidence-that-can-fail device (VERDICT r2
+    item 3): with flip rate p the best achievable top-1 against the noisy
+    labels is 1-p, so a parity experiment's dense arm plateaus at ~1-p
+    instead of saturating at 1.000 — and a compression-induced quality drop
+    becomes measurable instead of invisible.
+    """
+    if fraction <= 0:
+        return y
+    rng = np.random.default_rng(seed * 1_000_003 + 777)
+    flip = rng.random(len(y)) < fraction
+    offs = rng.integers(1, num_classes, size=len(y)).astype(y.dtype)
+    return np.where(flip, (y + offs) % num_classes, y)
+
+
 def synthetic_seq2seq(num: int, src_len: int, tgt_len: int, vocab_size: int,
                       pad_id: int = 0, seed: int = 0):
     """Copy-reverse task: tgt = reversed(src) — learnable seq2seq mapping."""

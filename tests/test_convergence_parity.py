@@ -40,6 +40,26 @@ def test_gaussian_reaches_dense_quality(tmp_path):
     assert sparse["val_loss"] < 0.2 and dense["val_loss"] < 0.2
 
 
+def test_parity_gate_on_nonsaturating_task(tmp_path):
+    """The evidence-that-can-fail gate (VERDICT r2 item 3): with 25% label
+    noise the top-1 ceiling is 0.75, so the dense arm CANNOT saturate at
+    1.000 — and the compressed arm at the reference's headline density
+    (0.1%) must land within tolerance of wherever dense actually lands.
+    The full 2k-step x 3-seed version with error bars is
+    analysis/convergence_parity.py --label-noise; this is its in-suite
+    gate at reduced steps."""
+    steps = 220
+    common = dict(dataset_kwargs={"label_noise": 0.25}, density=0.001,
+                  compress_warmup_steps=20, lr=0.01)
+    dense = _run(tmp_path, "dense_noise", steps, compressor="none", **common)
+    sparse = _run(tmp_path, "gw_noise", steps, compressor="gaussian_warm",
+                  **common)
+    # the task discriminates: dense sits well below saturation
+    assert 0.50 < dense["top1"] < 0.92, dense
+    # and compression at 0.1% stays within tolerance of dense
+    assert sparse["top1"] > dense["top1"] - 0.08, (dense, sparse)
+
+
 @pytest.mark.skipif(os.environ.get("GKSGD_RUN_SLOW") != "1",
                     reason="slow 4-arm run; full version is "
                            "analysis/convergence_parity.py (set "
