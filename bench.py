@@ -2,70 +2,111 @@
 
 Headline metric (BASELINE north-star, SURVEY.md §6): sparse-step throughput
 as a fraction of dense-step throughput on the same model/batch, target
->= 0.90 ("sparse must not lose to dense"). Measured on ResNet-20/CIFAR-10 at
-the reference's 8-way global batch (8 workers x 128 = 1024) with the
-TPU-native selector family at density 0.1%; VGG-16 (BASELINE config 2's
-showcase model, where compression matters most) is measured alongside and
-reported in detail.vgg16.
+>= 0.90 ("sparse must not lose to dense").
 
-Methodology lives in gaussiank_sgd_tpu/benchlib.py: N steps per dispatch via
-a jitted fori_loop, scalar fence, interleaved rotated rounds, min per
-variant. The headline value is the best compressor's ratio (detail names
-the winner). vs_baseline = value / 0.90.
+De-cherry-picked per VERDICT r2 item 6: the headline is the MEDIAN-of-rounds
+ratio for ONE fixed, named selector (approxtopk16 — the bf16-ranking
+hardware select, the framework's fastest honest default) on the flagship
+ResNet-20 config; min-of-rounds and the best-of-3-selectors winner are
+reported as SECONDARY fields. detail.configs carries the same
+fixed-selector median/min ratio plus MFU for ALL FIVE BASELINE configs with
+per-round dispersion, so no favorable cell can carry the number.
 
-The full BASELINE config matrix (all 5 configs x density sweep) is
-analysis/bench_matrix.py; this file stays minimal for the driver.
+Methodology (gaussiank_sgd_tpu/benchlib.py): N steps per dispatch via a
+jitted fori_loop, scalar fence, interleaved rotated rounds. MFU = dense-step
+HLO FLOPs / (step time x chip bf16 peak) — the absolute-performance leg
+(VERDICT r2 item 2).
 """
 
 from __future__ import annotations
 
 import json
+import statistics
 
 import jax
 
+FIXED = "approxtopk16"          # the fixed headline selector
+SWEEP = ("approxtopk16", "approxtopk", "gaussian_warm")
+
+# (key, model, dataset, per-chip batch, n_steps, rounds)
+CONFIGS = (
+    ("resnet20", "resnet20", "cifar10", 1024, 40, 6),
+    ("vgg16", "vgg16", "cifar10", 256, 20, 5),
+    ("resnet50", "resnet50", "imagenet", 64, 10, 4),
+    ("lstm_ptb", "lstm", "ptb", 160, 10, 4),
+    ("transformer_wmt", "transformer", "wmt", 64, 10, 4),
+)
+
+
+def _ratios(times, name):
+    """median/min sparse:dense ratios from per-round samples, paired by
+    round index (both programs ran inside every round)."""
+    dr = times["_rounds"]["dense"]
+    sr = times["_rounds"][name]
+    per_round = [d / s for d, s in zip(dr, sr)]
+    return {
+        "ratio_median": round(statistics.median(per_round), 4),
+        "ratio_min": round(min(per_round), 4),
+        "ratio_max": round(max(per_round), 4),
+        "round_ratios": [round(r, 4) for r in per_round],
+    }
+
 
 def main():
-    from gaussiank_sgd_tpu.benchlib import bench_model
+    from gaussiank_sgd_tpu.benchlib import bench_model, mfu
 
     density = 0.001
-    # approxtopk (f32) stays in the sweep as the reference point for its
-    # bf16-ranking variant — the comparison BASELINE.md cites must stay
-    # reproducible and an approxtopk16 regression must stay visible.
-    # (plain 'gaussian' is covered by analysis/bench_matrix.py; keeping the
-    # headline sweep to 3 sparse programs bounds driver wall-clock)
-    compressors = ("approxtopk16", "approxtopk", "gaussian_warm")
+    detail_configs = {}
+    headline = None
+    for key, model, dataset, batch, n_steps, rounds in CONFIGS:
+        # the flagship config also runs the 3-selector sweep (secondary
+        # winner field); the other configs run the fixed selector only to
+        # bound driver wall-clock
+        comps = SWEEP if key == "resnet20" else (FIXED,)
+        times = bench_model(model, dataset, batch, density, comps,
+                            n_steps=n_steps, rounds=rounds)
+        flops = times.get("_dense_step_flops")
+        peak = times.get("_peak_flops")
+        md = mfu(flops, times["dense"], peak)
+        ms = mfu(flops, times[FIXED], peak)
+        cell = {
+            "compressor": FIXED,
+            "dense_step_ms": round(1e3 * times["dense"], 3),
+            "sparse_step_ms": round(1e3 * times[FIXED], 3),
+            "ex_per_s_chip": round(batch / times[FIXED], 1),
+            "mfu_dense": round(md, 4) if md else None,
+            "mfu_sparse": round(ms, 4) if ms else None,
+            **_ratios(times, FIXED),
+        }
+        if key == "resnet20":
+            winner = min(SWEEP, key=lambda c: times[c])
+            cell["winner_secondary"] = {
+                "compressor": winner,
+                **_ratios(times, winner),
+                "all_sparse_ms": {c: round(1e3 * times[c], 3)
+                                  for c in SWEEP},
+            }
+            headline = cell
+        detail_configs[key] = cell
+        print(f"# {key}: median {cell['ratio_median']} "
+              f"min {cell['ratio_min']} mfu_dense {cell['mfu_dense']}",
+              flush=True)
 
-    times = bench_model("resnet20", "cifar10", 1024, density, compressors,
-                        n_steps=40, rounds=8)
-    winner = min(compressors, key=lambda c: times[c])
-    ratio = times["dense"] / times[winner]
-
-    vgg = bench_model("vgg16", "cifar10", 256, density, (winner,),
-                      n_steps=20, rounds=6)
-    vgg_ratio = vgg["dense"] / vgg[winner]
-
+    value = headline["ratio_median"]
+    worst = min(detail_configs.values(), key=lambda c: c["ratio_median"])
     result = {
         "metric": "sparse_vs_dense_step_throughput_ratio",
-        "value": round(ratio, 4),
+        "value": value,
         "unit": "ratio",
-        "vs_baseline": round(ratio / 0.90, 4),
+        "vs_baseline": round(value / 0.90, 4),
         "detail": {
-            "model": "resnet20", "batch": 1024, "density": density,
-            "compressor": winner,
-            "dense_step_ms": round(1e3 * times["dense"], 3),
-            "sparse_step_ms": round(1e3 * times[winner], 3),
-            "sparse_images_per_s": round(1024 / times[winner], 1),
-            "all_sparse_ms": {c: round(1e3 * times[c], 3)
-                              for c in compressors},
-            "vgg16": {
-                "batch": 256, "compressor": winner,
-                "ratio": round(vgg_ratio, 4),
-                "dense_step_ms": round(1e3 * vgg["dense"], 3),
-                "sparse_step_ms": round(1e3 * vgg[winner], 3),
-                "sparse_images_per_s": round(256 / vgg[winner], 1),
-            },
+            "headline": f"median-of-rounds ratio, fixed selector {FIXED}, "
+                        f"resnet20/b1024, density {density}",
+            "worst_config_ratio_median": worst["ratio_median"],
+            "configs": detail_configs,
             "methodology": "N-step fori_loop per dispatch, scalar fence, "
-                           "interleaved rounds, min per variant",
+                           "interleaved rotated rounds; ratios paired "
+                           "per round; median headline, min secondary",
             "platform": jax.devices()[0].platform,
             "n_devices": 1,
         },

@@ -155,13 +155,19 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
         _ = float(m.loss)
 
     out = {k: float("inf") for k in programs}
+    round_times = {k: [] for k in programs}
     names = list(programs)
     for r in range(rounds):
         # rotate the within-round order — a fixed order hands whatever
         # first-slot penalty exists to the same variant every round
         for name in names[r % len(names):] + names[:r % len(names)]:
             fn, mk = programs[name]
-            out[name] = min(out[name], _run_once(fn, mk, batch, n_steps))
+            t = _run_once(fn, mk, batch, n_steps)
+            round_times[name].append(t)
+            out[name] = min(out[name], t)
+    # per-round samples for median/dispersion reporting (VERDICT r2 item 6:
+    # min-of-rounds alone lets drift-band artifacts carry a headline)
+    out["_rounds"] = round_times
     if include_dense:
         # absolute-performance leg (VERDICT r2 item 2): the dense step's
         # HLO FLOP count is the model-FLOPs numerator for every variant's
