@@ -97,6 +97,9 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=0.005)
     p.add_argument("--weight-decay", type=float, default=0.0)
     p.add_argument("--compress-warmup-steps", type=int, default=10)
+    p.add_argument("--clip-norm", dest="clip_norm", type=float, default=None,
+                   help="global grad-norm clip (the reference's LSTM "
+                        "setting, SURVEY.md §3.2)")
     p.add_argument("--arms", default=DEFAULT_ARMS,
                    help="comma list of compressor[@exchange]; 'none' = the "
                         "dense baseline arm")
@@ -135,6 +138,7 @@ def main(argv=None):
                   data_dir=args.data_dir,
                   model_kwargs=args.model_kwargs,
                   dataset_kwargs=dataset_kwargs,
+                  clip_norm=args.clip_norm,
                   compress_warmup_steps=args.compress_warmup_steps)
     from gaussiank_sgd_tpu.compressors import NAMES as COMP_NAMES
     arms = []

@@ -28,7 +28,6 @@ carries no warm-up branching.
 from __future__ import annotations
 
 import functools
-import math
 from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -403,9 +402,9 @@ def build_dp_train_step(
             gcomp = gtopk_allreduce(comp, mesh.size, gather_axis)
             dense = decompress(gcomp, n_total, grad_dtype) / _all_axes_size()
             residual = global_residual(acc, gcomp)
-            bytes_sent = jnp.int32(
-                k_packed * (4 + comp.values.dtype.itemsize)
-                * max(1, int(math.log2(mesh.size))))
+            # trace-time count of the buffers actually ppermuted (shape x
+            # itemsize per butterfly round) — measured, not a formula
+            bytes_sent = jnp.int32(gtopk_allreduce.last_bytes_sent)
         else:
             # ONE all-gather of the packed pairs over the (ICI) gather axis,
             # scatter-summed dense; hierarchical meshes psum the dense
