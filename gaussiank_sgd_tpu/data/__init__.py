@@ -17,8 +17,9 @@ import numpy as np
 from .cifar import make_cifar, make_mnist
 from .loader import ArrayDataset, BucketedDataset, prefetch
 from .ptb import PTBDataset, make_ptb
-from .synthetic import (synthetic_images, synthetic_seq2seq,
-                        synthetic_spectrograms, synthetic_tokens)
+from .synthetic import (flip_labels, synthetic_images, synthetic_images_u8,
+                        synthetic_seq2seq, synthetic_spectrograms,
+                        synthetic_tokens)
 
 
 def make_imagenet(data_dir: Optional[str] = None, train: bool = True,
@@ -30,6 +31,13 @@ def make_imagenet(data_dir: Optional[str] = None, train: bool = True,
     ``{split}_labels.npy`` (preprocessing to packed arrays is done offline;
     full TFDS/grain integration is deliberately out of scope for this
     offline machine — SURVEY.md §7 hard part 5).
+
+    Pixel dtype contract: batches are served as **uint8** whenever possible
+    (synthetic path, or a u8 ``.npy``) and normalized ON DEVICE inside the
+    jitted step (training/losses.py ``_prep_pixels``) — 4x less
+    host->device traffic than pre-normalized f32, which is what lets the
+    224^2 pipeline keep a chip fed (analysis/io_pipeline_bench.py). An f32
+    ``.npy`` (already normalized offline) passes through unchanged.
     """
     split = "train" if train else "val"
     if data_dir and data_dir != "synthetic":
@@ -41,8 +49,9 @@ def make_imagenet(data_dir: Optional[str] = None, train: bool = True,
             y = np.load(yi).astype(np.int32)
             return ArrayDataset((x, y), batch_size, shuffle=train,
                                 seed=seed), 1000
-    x, y = synthetic_images(synthetic_examples, (image_size, image_size, 3),
-                            1000, seed=0 if train else 1)
+    x, y = synthetic_images_u8(synthetic_examples,
+                               (image_size, image_size, 3), 1000,
+                               seed=0 if train else 1)
     return ArrayDataset((x, y), batch_size, shuffle=train, seed=seed), 1000
 
 

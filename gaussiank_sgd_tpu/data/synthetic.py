@@ -90,6 +90,21 @@ def synthetic_tokens(num_tokens: int, vocab_size: int, seed: int = 0,
     raise ValueError(f"unsupported markov order {order}")
 
 
+def synthetic_images_u8(num: int, shape: Tuple[int, ...], num_classes: int,
+                        seed: int = 0, noise: float = 0.3,
+                        task_seed: int = 12345):
+    """uint8 variant of synthetic_images for the device-normalize pipeline.
+
+    The class-template signal survives quantization (templates span ~±3
+    in f32; mapped to ~128±48 u8 levels), so the task stays learnable while
+    batches ship at 1/4 the bytes of f32 — the input-pipeline rate test
+    (SURVEY.md §7 hard part 5) measures the representative transfer volume.
+    """
+    x, y = synthetic_images(num, shape, num_classes, seed=seed, noise=noise,
+                            task_seed=task_seed)
+    return np.clip(128.0 + 48.0 * x, 0, 255).astype(np.uint8), y
+
+
 def flip_labels(y: np.ndarray, num_classes: int, fraction: float,
                 seed: int = 0) -> np.ndarray:
     """Symmetric label noise: flip ``fraction`` of labels to a uniformly

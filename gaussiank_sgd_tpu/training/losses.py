@@ -17,6 +17,27 @@ import optax
 from ..models import ModelSpec
 
 
+# ImageNet channel stats for the device-side u8 path (torchvision's, the
+# reference's own normalization constants)
+IMAGENET_NORM = (jnp.asarray([0.485, 0.456, 0.406], jnp.float32),
+                 jnp.asarray([0.229, 0.224, 0.225], jnp.float32))
+
+
+def _prep_pixels(x, input_norm):
+    """Normalize uint8 pixels ON DEVICE, inside the jitted step.
+
+    TPU-first input-pipeline design (SURVEY.md §7 hard part 5): datasets
+    ship uint8 — 4x less host->device traffic than pre-normalized f32 —
+    and XLA fuses this cast+scale into the first convolution. Float inputs
+    (pre-normalized offline, or synthetic) pass through untouched; the
+    dtype check is trace-time static.
+    """
+    if input_norm is not None and x.dtype == jnp.uint8:
+        mean, std = input_norm
+        return (x.astype(jnp.float32) / 255.0 - mean) / std
+    return x
+
+
 def _apply(spec: ModelSpec, params, mstate, rng, *inputs, **extra):
     """Train-mode apply, threading mutable collections + dropout rng."""
     variables = {"params": params, **mstate}
@@ -30,10 +51,13 @@ def _apply(spec: ModelSpec, params, mstate, rng, *inputs, **extra):
 
 
 def make_loss_fn(spec: ModelSpec, label_smoothing: float = 0.0,
-                 recurrent: bool = False) -> Callable:
+                 recurrent: bool = False, input_norm=None) -> Callable:
     """``recurrent=True`` (lm only): the carry-threading LossFn protocol of
     parallel/trainstep.py — consume the previous window's hidden state,
-    return the new one (the reference's bptt repackaging, SURVEY.md §3.2)."""
+    return the new one (the reference's bptt repackaging, SURVEY.md §3.2).
+
+    ``input_norm``: (mean, std) for uint8 pixel batches, applied on device
+    (see _prep_pixels); ignored for float/token inputs."""
     task = spec.task
 
     if recurrent:
@@ -52,6 +76,7 @@ def make_loss_fn(spec: ModelSpec, label_smoothing: float = 0.0,
     if task == "classify":
         def loss_fn(params, mstate, batch, rng):
             x, y = batch
+            x = _prep_pixels(x, input_norm)
             logits, mstate = _apply(spec, params, mstate, rng, x)
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, y).mean()
@@ -104,7 +129,8 @@ def make_loss_fn(spec: ModelSpec, label_smoothing: float = 0.0,
     raise ValueError(f"unknown task {task!r}")
 
 
-def make_eval_fn(spec: ModelSpec, recurrent: bool = False) -> Callable:
+def make_eval_fn(spec: ModelSpec, recurrent: bool = False,
+                 input_norm=None) -> Callable:
     """(params, mstate, batch) -> dict of SUMS (caller psums + normalizes).
 
     Eval-mode apply (train=False, running BatchNorm stats, no dropout).
@@ -138,6 +164,7 @@ def make_eval_fn(spec: ModelSpec, recurrent: bool = False) -> Callable:
     if task == "classify":
         def eval_fn(params, mstate, batch):
             x, y = batch
+            x = _prep_pixels(x, input_norm)
             logits = apply_eval(params, mstate, x)
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
             top1 = (logits.argmax(-1) == y).sum()

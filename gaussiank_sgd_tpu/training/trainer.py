@@ -153,9 +153,16 @@ class Trainer:
         plan = plan_for_params(params, cfg.density, cfg.bucket_size,
                                policy=cfg.bucket_policy)
         self.plan = plan
+        # uint8 pixel batches (imagenet contract) normalize ON DEVICE —
+        # the dtype check inside _prep_pixels is trace-time static, so
+        # float batches pay nothing
+        from .losses import IMAGENET_NORM
+        input_norm = (IMAGENET_NORM if cfg.dataset.lower() == "imagenet"
+                      else None)
         self.ts = build_dp_train_step(
             make_loss_fn(self.spec, cfg.label_smoothing,
-                         recurrent=self.recurrent), optimizer, comp,
+                         recurrent=self.recurrent,
+                         input_norm=input_norm), optimizer, comp,
             plan, self.mesh,
             num_microbatches=cfg.nsteps_update,
             clip_norm=cfg.clip_norm,
@@ -171,7 +178,8 @@ class Trainer:
         self.is_dense_only = comp.name == "none"
 
         # ---- eval step: shard_map'd sum-reduce over dp ----
-        eval_fn = make_eval_fn(self.spec, recurrent=self.recurrent)
+        eval_fn = make_eval_fn(self.spec, recurrent=self.recurrent,
+                               input_norm=input_norm)
         axes = tuple(self.mesh.axis_names)
         self._eval_bs = eval_bs
 

@@ -85,3 +85,39 @@ def test_markov_tokens_are_predictable():
     correct = sum(nxt[a].most_common(1)[0][1] for a in nxt)
     acc = correct / (len(toks) - 1)
     assert acc > 0.2, acc  # uniform would be 0.01
+
+
+def test_imagenet_u8_pipeline_and_device_normalize():
+    """The imagenet contract ships uint8 pixels (4x less transfer) and the
+    loss normalizes on device (training/losses.py _prep_pixels)."""
+    import jax.numpy as jnp
+
+    from gaussiank_sgd_tpu.data import make_imagenet
+    from gaussiank_sgd_tpu.training.losses import IMAGENET_NORM, _prep_pixels
+
+    ds, ncls = make_imagenet(None, train=True, batch_size=8, image_size=32,
+                             synthetic_examples=64)
+    x, y = next(iter(ds))
+    assert x.dtype == np.uint8 and x.shape == (8, 32, 32, 3)
+    assert ncls == 1000
+    xn = _prep_pixels(jnp.asarray(x), IMAGENET_NORM)
+    assert xn.dtype == jnp.float32
+    # normalized stats land in the standard range (mean ~0, |x| < ~3)
+    assert abs(float(xn.mean())) < 1.0
+    assert float(jnp.abs(xn).max()) < 4.0
+    # float inputs pass through untouched (static dtype check)
+    xf = jnp.ones((2, 4, 4, 3), jnp.float32) * 7.0
+    np.testing.assert_array_equal(np.asarray(_prep_pixels(xf, IMAGENET_NORM)),
+                                  np.asarray(xf))
+
+
+def test_label_noise_caps_ceiling():
+    """flip_labels: ~fraction of labels change, none to the same class."""
+    from gaussiank_sgd_tpu.data import flip_labels
+
+    y = np.random.default_rng(0).integers(0, 10, 10_000).astype(np.int32)
+    y2 = flip_labels(y, 10, 0.25, seed=3)
+    frac = float((y != y2).mean())
+    assert 0.20 < frac < 0.30, frac
+    assert y2.min() >= 0 and y2.max() < 10
+    np.testing.assert_array_equal(y, flip_labels(y, 10, 0.0, seed=3))
