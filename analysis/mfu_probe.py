@@ -1,0 +1,59 @@
+"""ResNet-50 dense MFU vs batch size (VERDICT r2 item 2's absolute leg).
+
+The BASELINE config 3 batch (64/chip) under-utilizes a v5e on 224^2
+convs; this probe measures dense-step MFU at b in {64, 128, 256} (bf16)
+so BASELINE.md can state where the model's compute ceiling sits and how
+far the contract batch is from it — separating "the framework is slow"
+from "the batch is small".
+
+Run on the TPU box:  python analysis/mfu_probe.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ARTIFACTS = os.path.join(REPO, "analysis", "artifacts")
+
+
+def main(argv=None):
+    import jax
+
+    from gaussiank_sgd_tpu.benchlib import bench_model, mfu
+
+    cells = []
+    for batch in (64, 128, 256):
+        times = bench_model("resnet50", "imagenet", batch, 0.001,
+                            ("approxtopk16",), n_steps=10, rounds=3)
+        flops = times.get("_dense_step_flops")
+        peak = times.get("_peak_flops")
+        md = mfu(flops, times["dense"], peak)
+        ms = mfu(flops, times["approxtopk16"], peak)
+        cells.append({
+            "batch": batch,
+            "dense_ms": round(1e3 * times["dense"], 3),
+            "sparse_ms": round(1e3 * times["approxtopk16"], 3),
+            "img_per_s_dense": round(batch / times["dense"], 1),
+            "flops_per_step": flops,
+            "mfu_dense": round(md, 4) if md else None,
+            "mfu_sparse_approxtopk16": round(ms, 4) if ms else None,
+        })
+        print(json.dumps(cells[-1]), flush=True)
+
+    out = {"model": "resnet50/224^2 bf16 dense step",
+           "platform": jax.devices()[0].platform,
+           "peak_flops_assumed": 197e12, "cells": cells}
+    os.makedirs(ARTIFACTS, exist_ok=True)
+    with open(os.path.join(ARTIFACTS, "mfu_probe.json"), "w") as f:
+        json.dump(out, f, indent=2)
+    print("wrote mfu_probe.json")
+    return out
+
+
+if __name__ == "__main__":
+    main()
