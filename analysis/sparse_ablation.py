@@ -1,0 +1,115 @@
+"""Where does the 57M-param sparse-step overhead go? (config 5 deep-dive)
+
+The transformer config's sparse:dense ratio is window-dependent (0.86-1.10)
+because the selection overhead is ~constant absolute ms while dense
+fwd+bwd drifts with the shared chip. Before optimizing further (Pallas
+fusion, EF-state restructure), this script decomposes the overhead by
+running ABLATED compressors that each do a prefix of the full pipeline,
+all interleaved in ONE bench_model run so the differences are drift-free:
+
+  ef_only       EF accumulate + exchange of a FIXED k-slice (no selection,
+                no residual scatter) — the floor every sparse step pays
+  sel_nores     + abs + bf16 cast + approx_max_k + gather (residual = acc
+                untouched: EF-INCORRECT, measurement only)
+  approxtopk16  + the residual scatter-copy (the real selector)
+  gaussian_warm the threshold-mask path (mask + key-trick pack + scatter)
+
+Differences: (sel_nores - ef_only) = selection cost; (approxtopk16 -
+sel_nores) = residual-write cost; (gaussian_warm - ef_only) = mask+pack
+cost. Writes analysis/artifacts/sparse_ablation.json.
+
+Run on the TPU box:  python analysis/sparse_ablation.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ARTIFACTS = os.path.join(REPO, "analysis", "artifacts")
+
+
+def _ablation_specs():
+    import jax
+    import jax.numpy as jnp
+
+    from gaussiank_sgd_tpu.compressors.base import (CompressedGrad,
+                                                    CompressResult)
+    from gaussiank_sgd_tpu.compressors.registry import CompressorSpec
+
+    def ef_only(acc, k, rng=None):
+        idx = jnp.arange(k, dtype=jnp.int32)
+        val = acc[:k]
+        # residual untouched minus the sent slice: one k-sized scatter
+        residual = acc.at[idx].set(0.0)
+        return CompressResult(CompressedGrad(idx, val), residual,
+                              jnp.asarray(k, jnp.int32))
+
+    def sel_nores(acc, k, rng=None):
+        mag = jnp.abs(acc).astype(jnp.bfloat16)
+        _, idx = jax.lax.approx_max_k(mag, k, recall_target=0.95)
+        idx = idx.astype(jnp.int32)
+        val = acc[idx]
+        # measurement-only: residual deliberately skips the scatter-copy
+        return CompressResult(CompressedGrad(idx, val), acc,
+                              jnp.asarray(k, jnp.int32))
+
+    return {
+        "ef_only": CompressorSpec("ef_only", ef_only, False, True,
+                                  lambda k: k),
+        "sel_nores": CompressorSpec("sel_nores", sel_nores, False, True,
+                                    lambda k: k),
+    }
+
+
+def main(argv=None):
+    import gaussiank_sgd_tpu.compressors as comps
+    from gaussiank_sgd_tpu.benchlib import bench_model
+
+    specs = _ablation_specs()
+    real_get = comps.get_compressor
+
+    def patched(name, **kw):
+        return specs.get(name) or real_get(name, **kw)
+
+    comps.get_compressor = patched
+    try:
+        names = ("ef_only", "sel_nores", "approxtopk16", "gaussian_warm")
+        times = bench_model("transformer", "wmt", 64, 0.001, names,
+                            n_steps=10, rounds=4)
+    finally:
+        comps.get_compressor = real_get
+
+    dense = times["dense"]
+    ms = {k: round(1e3 * v, 3) for k, v in times.items()
+          if isinstance(v, float)}
+    out = {
+        "model": "transformer 57M, b=64, density 0.001",
+        "ms": ms,
+        "decomposition_ms": {
+            "dense_fwd_bwd_update": ms["dense"],
+            "ef_exchange_floor": round(ms["ef_only"] - ms["dense"], 3),
+            "abs_cast_select_gather": round(
+                ms["sel_nores"] - ms["ef_only"], 3),
+            "residual_scatter_copy": round(
+                ms["approxtopk16"] - ms["sel_nores"], 3),
+            "warm_mask_pack_total": round(
+                ms["gaussian_warm"] - ms["ef_only"], 3),
+        },
+        "ratios": {k: round(dense / times[k], 4) for k in
+                   ("ef_only", "sel_nores", "approxtopk16",
+                    "gaussian_warm")},
+    }
+    os.makedirs(ARTIFACTS, exist_ok=True)
+    with open(os.path.join(ARTIFACTS, "sparse_ablation.json"), "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out, indent=2))
+    return out
+
+
+if __name__ == "__main__":
+    main()
