@@ -75,36 +75,46 @@ def k_for(numel: int, density: float) -> int:
 _EXACT_PACK_MAX = 1 << 21
 
 
-def pack_by_mask(acc: jax.Array, mask: jax.Array, k: int) -> CompressResult:
+def pack_by_mask(acc: jax.Array, mask: jax.Array, k: int,
+                 priority: str = "index") -> CompressResult:
     """Pack entries of ``acc`` where ``mask`` is True into exactly ``k`` slots.
 
     TPU-native compaction WITHOUT an n-sized scatter (XLA lowers a scatter
     with n updates to a serialized loop — measured ~93 ms on a 15M-element
     gradient): build a priority key that is positive exactly on selected
-    entries and decreasing in flat index, then take the top-k of the key —
-    one fused sort-free select op. Entries beyond ``k`` are dropped
-    lowest-index-first (same documented truncation contract as before) and
-    remain in the residual.
+    entries, then take the top-k of the key — one fused sort-free select
+    op. Anything not packed (truncation, or approx_max_k recall misses)
+    stays in the error-feedback residual, so no gradient mass is ever lost
+    (SURVEY.md §2.3 EF contract).
 
-    For very large tensors ``lax.approx_max_k`` is used: it may miss a
-    recall_target fraction of selected entries; anything missed is simply
-    NOT sent this step and stays in the error-feedback residual, so no
-    gradient mass is ever lost (SURVEY.md §2.3 EF contract).
+    ``priority``:
 
-    f32 key precision note: above 2^24 elements nearby indices can collide
-    to one key value; top_k then breaks ties by lowest index, so selection
-    stays deterministic — only the exact boundary entries under truncation
-    can differ from the infinite-precision order.
+    * ``"index"`` (default) — key decreases in flat index; entries beyond
+      ``k`` drop lowest-index-first (the documented deterministic
+      truncation contract; f32 key note: above 2^24 elements nearby
+      indices can collide to one key value — top_k then breaks ties by
+      lowest index, so selection stays deterministic).
+    * ``"magnitude"`` — key is the masked |acc| cast to bf16: overflow
+      drops the SMALLEST-magnitude entries instead (algorithmically
+      stronger — the residual keeps the least mass), and the key costs
+      half the HBM traffic of the f32 index key. Measured on the 57M
+      transformer this cuts the warm pack from ~10 ms to approxtopk16-
+      class cost. Entries whose magnitude rounds to bf16 zero are not
+      packed and stay in the residual.
     """
     n = acc.shape[0]
     num_selected = jnp.sum(mask.astype(jnp.int32))
-    key = jnp.where(mask, jnp.float32(n) - jnp.arange(n, dtype=jnp.float32),
-                    0.0)
+    if priority == "magnitude":
+        key = jnp.where(mask, jnp.abs(acc), 0.0).astype(jnp.bfloat16)
+    else:
+        key = jnp.where(mask,
+                        jnp.float32(n) - jnp.arange(n, dtype=jnp.float32),
+                        0.0)
     if n <= _EXACT_PACK_MAX:
         kv, ki = jax.lax.top_k(key, k)
     else:
         kv, ki = jax.lax.approx_max_k(key, k, recall_target=0.95)
-    valid = kv > 0.0                                # selected (not key-0 pad)
+    valid = kv > 0                                  # selected (not key-0 pad)
     idx = jnp.where(valid, ki, 0).astype(jnp.int32)
     val = jnp.where(valid, acc[idx], jnp.zeros((), acc.dtype))
     # zero exactly the sent entries; invalid slots scatter out-of-range (drop)

@@ -101,7 +101,11 @@ def gaussian_warm_compress(acc: jax.Array, k: int, state: jax.Array,
     usable = (state > 0) & (count_prev >= k // 4) & (count_prev <= 4 * k)
 
     def warm(_):
-        return pack_by_mask(acc, mask_prev, k), state
+        # magnitude-priority pack: bf16 key (half the HBM traffic of the
+        # f32 index key) and overflow drops the SMALLEST entries — see
+        # pack_by_mask. The cold path keeps index priority so it stays
+        # bit-identical to the stateless gaussian reference path.
+        return pack_by_mask(acc, mask_prev, k, priority="magnitude"), state
 
     def cold(_):
         t0 = gaussian_threshold_estimate(acc, density, sigma_scale)
@@ -143,7 +147,8 @@ def gaussian_warm_compress_batched(x: jax.Array, k: int, state: jax.Array,
     usable = (state > 0) & (count_prev >= k // 4) & (count_prev <= 4 * k)
 
     def warm(_):
-        res = jax.vmap(lambda xc, mc: pack_by_mask(xc, mc, k))(x, mask_prev)
+        res = jax.vmap(lambda xc, mc: pack_by_mask(
+            xc, mc, k, priority="magnitude"))(x, mask_prev)
         return res, state
 
     def cold(_):
