@@ -65,10 +65,14 @@ def make_ptb(data_dir: Optional[str] = None, split: str = "train",
              batch_size: int = 20, bptt: int = 35,
              vocab_size: int = 10000,
              synthetic_tokens_n: int = 200_000,
-             synthetic_order: int = 1) -> Tuple[PTBDataset, int]:
+             synthetic_order: int = 1,
+             seed: Optional[int] = None) -> Tuple[PTBDataset, int]:
     """Returns (dataset, vocab_size). ``synthetic_order``: Markov order of
     the offline stand-in stream (2 = cross-window dependencies, the carry
-    test setting — see synthetic.py)."""
+    test setting — see synthetic.py). ``seed`` is accepted for interface
+    uniformity with the shuffled pipelines (multi-seed experiment harnesses
+    pass it to every dataset) but unused: contiguous text is served
+    sequentially, so order is deterministic by construction."""
     if data_dir and data_dir != "synthetic":
         train_path = os.path.join(data_dir, "ptb.train.txt")
         path = os.path.join(data_dir, f"ptb.{split}.txt")
