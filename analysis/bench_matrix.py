@@ -55,6 +55,11 @@ def main(argv=None):
                    help="one density, fewer rounds (smoke)")
     p.add_argument("--configs", default=None,
                    help="comma-separated substring filter on config names")
+    p.add_argument("--tag", default="",
+                   help="suffix for the artifact filenames (e.g. 'paired' "
+                        "-> bench_matrix_paired.{json,md}) so a re-run "
+                        "never clobbers a window it should be compared "
+                        "against")
     args = p.parse_args(argv)
 
     import jax
@@ -63,6 +68,7 @@ def main(argv=None):
 
     densities = (0.001,) if args.quick else DENSITIES
     rounds = 3 if args.quick else 6
+    suffix = f"_{args.tag}" if args.tag else ""
     os.makedirs(ARTIFACTS, exist_ok=True)
 
     results = []
@@ -109,26 +115,35 @@ def main(argv=None):
         results.append(row)
         # write incrementally: an hour of chip measurements must survive a
         # crash in a later config
-        with open(os.path.join(ARTIFACTS, "bench_matrix.json"), "w") as f:
+        with open(os.path.join(ARTIFACTS,
+                               f"bench_matrix{suffix}.json"), "w") as f:
             json.dump(results, f, indent=2)
 
+    table = render_md(results)
+    with open(os.path.join(ARTIFACTS, f"bench_matrix{suffix}.md"), "w") as f:
+        f.write(table + "\n")
+    print(table)
+    return results
+
+
+def render_md(results) -> str:
     lines = ["| Config | density | compressor | dense ms | sparse ms | "
-             "sparse:dense | ex/s/chip | MFU dense | MFU sparse |",
-             "|---|---|---|---|---|---|---|---|---|"]
+             "sparse:dense | paired median | paired spread | ex/s/chip | "
+             "MFU dense | MFU sparse |",
+             "|---|---|---|---|---|---|---|---|---|---|---|"]
     for row in results:
         for c in row["cells"]:
             fmt = lambda v: f"{100 * v:.1f}%" if v else "—"
+            spread = c.get("ratio_spread_paired")
             lines.append(
                 f"| {row['config']} (b={row['batch_per_chip']}) "
                 f"| {c['density']} | {c['compressor']} | {c['dense_ms']} "
                 f"| {c['sparse_ms']} | {c['ratio']} "
+                f"| {c.get('ratio_median_paired') or '—'} "
+                f"| {f'{spread[0]}–{spread[1]}' if spread else '—'} "
                 f"| {c['ex_per_s_chip']} | {fmt(c['mfu_dense'])} "
                 f"| {fmt(c['mfu_sparse'])} |")
-    table = "\n".join(lines)
-    with open(os.path.join(ARTIFACTS, "bench_matrix.md"), "w") as f:
-        f.write(table + "\n")
-    print(table)
-    return results
+    return "\n".join(lines)
 
 
 if __name__ == "__main__":

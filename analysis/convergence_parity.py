@@ -208,6 +208,8 @@ def main(argv=None):
             for da, ra in zip(dense["seed_runs"], r["seed_runs"]):
                 if da[key] is None or ra[key] is None:
                     continue
+                if rel and da[key] == 0:       # fully-saturated dense arm:
+                    continue                   # a ratio is undefined, skip
                 gaps.append((ra[key] / da[key]) if rel
                             else (da[key] - ra[key]))
             return _agg(gaps)
