@@ -41,6 +41,9 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--quick", action="store_true")
     p.add_argument("--configs", default=None)
+    p.add_argument("--tag", default="",
+                   help="artifact filename suffix — a re-run never "
+                        "clobbers the window it is compared against")
     args = p.parse_args(argv)
 
     import jax
@@ -50,7 +53,8 @@ def main(argv=None):
     rounds = 2 if args.quick else 4
     density = 0.001
     os.makedirs(ARTIFACTS, exist_ok=True)
-    out_path = os.path.join(ARTIFACTS, "lm_fastpath.json")
+    suffix = f"_{args.tag}" if args.tag else ""
+    out_path = os.path.join(ARTIFACTS, f"lm_fastpath{suffix}.json")
 
     results = []
     for name, model, dataset, batch, n_steps in CONFIGS:
