@@ -86,7 +86,7 @@ def main(argv=None):
 
     dense = times["dense"]
     ms = {k: round(1e3 * v, 3) for k, v in times.items()
-          if isinstance(v, float)}
+          if isinstance(v, float) and not k.startswith("_")}
     out = {
         "model": "transformer 57M, b=64, density 0.001",
         "ms": ms,

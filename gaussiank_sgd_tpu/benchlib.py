@@ -106,7 +106,11 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
                 dtype=jnp.bfloat16, bucket_policy: str = "greedy",
                 bucket_size: Optional[int] = None) -> Dict[str, float]:
     """Per-step seconds for the dense program + each compressor's sparse
-    program on one model. Keys: 'dense' + compressor names.
+    program on one model. Timing keys: 'dense' + compressor names.
+    Underscore-prefixed keys are metadata, NOT timings: ``_rounds``
+    (per-round samples, dict of lists), ``_dense_step_flops`` and
+    ``_peak_flops`` (MFU inputs) — consumers iterating the dict must
+    filter them.
 
     ``bucket_policy``/``bucket_size``: the selection-unit plan (SURVEY.md
     §2.3 bucketing). The VERDICT-r2 scaling recipe for 20M+ LM models is
@@ -134,6 +138,7 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
     carry = (spec.module.initial_carry(batch_size) if recurrent else ())
 
     programs = {}
+    dense_ts = dense_mk = None
     for name in compressors:
         comp = get_compressor(name, density=density)
         ts = build_dp_train_step(
@@ -168,7 +173,7 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
     # per-round samples for median/dispersion reporting (VERDICT r2 item 6:
     # min-of-rounds alone lets drift-band artifacts carry a headline)
     out["_rounds"] = round_times
-    if include_dense:
+    if include_dense and dense_ts is not None:
         # absolute-performance leg (VERDICT r2 item 2): the dense step's
         # HLO FLOP count is the model-FLOPs numerator for every variant's
         # MFU (sparse MFU counts useful model math per second; selection
