@@ -60,13 +60,19 @@ def main(argv=None):
                         "-> bench_matrix_paired.{json,md}) so a re-run "
                         "never clobbers a window it should be compared "
                         "against")
+    p.add_argument("--densities", default=None,
+                   help="comma list overriding the density sweep "
+                        "(e.g. '0.1,0.01')")
     args = p.parse_args(argv)
 
     import jax
 
     from gaussiank_sgd_tpu.benchlib import bench_model, mfu
 
-    densities = (0.001,) if args.quick else DENSITIES
+    if args.densities:
+        densities = tuple(float(d) for d in args.densities.split(","))
+    else:
+        densities = (0.001,) if args.quick else DENSITIES
     rounds = 3 if args.quick else 6
     suffix = f"_{args.tag}" if args.tag else ""
     os.makedirs(ARTIFACTS, exist_ok=True)
