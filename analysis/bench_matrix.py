@@ -43,10 +43,21 @@ CONFIGS = [
     ("config2_vgg16", "vgg16", "cifar10", 256, {}, 20, "greedy", None),
     ("config3_resnet50", "resnet50", "imagenet", 64, {}, 10, "greedy", None),
     ("config4_lstm_ptb", "lstm", "ptb", 160, {}, 10, "greedy", None),
-    ("config5_transformer", "transformer", "wmt", 64, {}, 10, "greedy", None),
+    # b32 = the exp_configs/config5*.json per-chip batch (VERDICT r3 item 8)
+    ("config5_transformer", "transformer", "wmt", 32, {}, 10, "greedy", None),
 ]
 DENSITIES = (0.1, 0.01, 0.001)
-COMPRESSORS = ("approxtopk", "gaussian", "gaussian_warm", "approxtopk16")
+COMPRESSORS = ("approxtopk", "gaussian", "gaussian_warm", "approxtopk16",
+               "gaussian_fused")
+# prefix probe for the per-cell phase decomposition (benchlib.ablation_specs)
+PROBE = "ef_only"
+
+
+def _paired_delta_ms(rounds: dict, a: str, b: str):
+    """median over rounds of (a_r - b_r), in ms — drift-robust phase delta."""
+    pairs = [1e3 * (x - y) for x, y in zip(rounds.get(a, []),
+                                           rounds.get(b, []))]
+    return round(statistics.median(pairs), 3) if pairs else None
 
 
 def main(argv=None):
@@ -67,7 +78,11 @@ def main(argv=None):
 
     import jax
 
+    from gaussiank_sgd_tpu import virtual_cpu
     from gaussiank_sgd_tpu.benchlib import bench_model, mfu
+
+    # persistent compile cache across matrix runs/windows (TPU backend too)
+    virtual_cpu.enable_compile_cache("/tmp/gksgd_tpu_cache")
 
     if args.densities:
         densities = tuple(float(d) for d in args.densities.split(","))
@@ -87,7 +102,11 @@ def main(argv=None):
                "platform": jax.devices()[0].platform, "cells": []}
         for d in densities:
             print(f"=== {name} density={d} ===", flush=True)
-            times = bench_model(model, dataset, batch, d, COMPRESSORS,
+            from gaussiank_sgd_tpu.ops.pallas_pack import supports_density
+            comps = tuple(c for c in COMPRESSORS
+                          if c != "gaussian_fused" or supports_density(d))
+            times = bench_model(model, dataset, batch, d,
+                                comps + (PROBE,),
                                 n_steps=n_steps, rounds=rounds,
                                 model_kwargs=mkw, bucket_policy=policy,
                                 bucket_size=bsize)
@@ -95,7 +114,7 @@ def main(argv=None):
             flops = times.get("_dense_step_flops")
             peak = times.get("_peak_flops")
             rnds = times.get("_rounds", {})
-            for c in COMPRESSORS:
+            for c in comps:
                 md, ms = mfu(flops, dense, peak), mfu(flops, times[c], peak)
                 # round-paired ratios (dense and sparse timed within the
                 # SAME rotated round) — robust to cross-window drift, the
@@ -116,8 +135,22 @@ def main(argv=None):
                     "flops_per_step": flops,
                     "mfu_dense": round(md, 4) if md else None,
                     "mfu_sparse": round(ms, 4) if ms else None,
+                    # per-phase breakdown (VERDICT r3 item 6), from the
+                    # ef_only prefix probe timed in the same rotated
+                    # rounds: fwd+bwd+update = the dense program;
+                    # exchange = the fixed-k EF floor's delta over it;
+                    # select+pack = this selector's delta over the floor.
+                    # All three phase figures come from the SAME estimator
+                    # (per-round medians / paired-median deltas) so the
+                    # column reconciles with itself; min-of-rounds deltas
+                    # can cross drift regimes and go negative
+                    # (sparse_ablation r4 note, code-review r4)
+                    "fwd_bwd_ms": (round(1e3 * statistics.median(
+                        rnds["dense"]), 3) if rnds.get("dense") else None),
+                    "exchange_ms": _paired_delta_ms(rnds, PROBE, "dense"),
+                    "select_pack_ms": _paired_delta_ms(rnds, c, PROBE),
                 })
-            print(json.dumps(row["cells"][-len(COMPRESSORS):]), flush=True)
+            print(json.dumps(row["cells"][-len(comps):]), flush=True)
         results.append(row)
         # write incrementally: an hour of chip measurements must survive a
         # crash in a later config
@@ -135,8 +168,8 @@ def main(argv=None):
 def render_md(results) -> str:
     lines = ["| Config | density | compressor | dense ms | sparse ms | "
              "sparse:dense | paired median | paired spread | ex/s/chip | "
-             "MFU dense | MFU sparse |",
-             "|---|---|---|---|---|---|---|---|---|---|---|"]
+             "MFU dense | MFU sparse | phases fb/ex/sel ms |",
+             "|---|---|---|---|---|---|---|---|---|---|---|---|"]
     for row in results:
         for c in row["cells"]:
             fmt = lambda v: f"{100 * v:.1f}%" if v else "—"
@@ -148,7 +181,9 @@ def render_md(results) -> str:
                 f"| {c.get('ratio_median_paired') or '—'} "
                 f"| {f'{spread[0]}–{spread[1]}' if spread else '—'} "
                 f"| {c['ex_per_s_chip']} | {fmt(c['mfu_dense'])} "
-                f"| {fmt(c['mfu_sparse'])} |")
+                f"| {fmt(c['mfu_sparse'])} "
+                f"| {c.get('fwd_bwd_ms', '—')}/{c.get('exchange_ms', '—')}"
+                f"/{c.get('select_pack_ms', '—')} |")
     return "\n".join(lines)
 
 

@@ -65,6 +65,7 @@ def run_arm(name, steps, density, outdir, **overrides):
         "val_loss": res["val_loss"],
         "top1": res.get("top1"),
         "perplexity": res.get("perplexity"),
+        "cer": res.get("cer"),
         # last-step exchange payload; the dense arm's value is its FULL
         # dense gradient (no compression)
         "bytes_per_step": tr[-1]["bytes_sent"],
@@ -131,6 +132,12 @@ def main(argv=None):
 
     dataset_kwargs = dict(args.dataset_kwargs)
     if args.label_noise > 0:
+        # only the classification factories accept label_noise; fail at the
+        # CLI with a clear message instead of a TypeError deep in dataset
+        # construction (ADVICE r3)
+        if args.dataset not in ("mnist", "cifar10", "cifar100"):
+            p.error(f"--label-noise applies to the mnist/cifar10/cifar100 "
+                    f"factories only, not {args.dataset!r}")
         dataset_kwargs["label_noise"] = args.label_noise
     common = dict(dnn=args.dnn, dataset=args.dataset,
                   batch_size=args.batch_size, lr=args.lr,
@@ -168,9 +175,10 @@ def main(argv=None):
         r = dict(runs[0])                       # arm metadata + seed-0 curve
         r["arm"] = name
         r["seed_runs"] = [{k: run[k] for k in
-                           ("final_loss", "val_loss", "top1", "perplexity")}
+                           ("final_loss", "val_loss", "top1", "perplexity",
+                            "cer")}
                           for run in runs]
-        for key in ("final_loss", "val_loss", "top1", "perplexity"):
+        for key in ("final_loss", "val_loss", "top1", "perplexity", "cer"):
             r[key + "_agg"] = _agg([run[key] for run in runs])
             r[key] = r[key + "_agg"]["mean"] if r[key + "_agg"] else None
         results.append(r)
@@ -196,9 +204,10 @@ def main(argv=None):
                        if v not in (None, "") and v != {})},
         "arms": [{k: r.get(k) for k in
                   ("arm", "compressor", "exchange", "final_loss",
-                   "val_loss", "top1", "perplexity", "bytes_per_step",
-                   "final_loss_agg", "val_loss_agg", "top1_agg",
-                   "perplexity_agg")} for r in results],
+                   "val_loss", "top1", "perplexity", "cer",
+                   "bytes_per_step", "final_loss_agg", "val_loss_agg",
+                   "top1_agg", "perplexity_agg", "cer_agg")}
+                 for r in results],
     }
     if dense is not None:   # a parity block only makes sense vs a dense arm
         def paired_gap(r, key, rel=False):
@@ -221,6 +230,7 @@ def main(argv=None):
                                                       rel=True),
                 "perplexity_ratio_vs_dense": paired_gap(r, "perplexity",
                                                         rel=True),
+                "cer_gap_vs_dense": paired_gap(r, "cer"),
             } for r in results if r is not dense
         }
     tag = (f"_{args.tag.lstrip('_')}" if args.tag else

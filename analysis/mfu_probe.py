@@ -45,9 +45,15 @@ def main(argv=None):
         })
         print(json.dumps(cells[-1]), flush=True)
 
+    from gaussiank_sgd_tpu.benchlib import device_peak_flops
+
+    # record the denominator actually used (device_peak_flops of THIS chip,
+    # None on CPU where MFU is None) plus the device kind — ADVICE r3: a
+    # hardcoded v5e constant mislabels runs on other chip generations
     out = {"model": "resnet50/224^2 bf16 dense step",
            "platform": jax.devices()[0].platform,
-           "peak_flops_assumed": 197e12, "cells": cells}
+           "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+           "peak_flops_used": device_peak_flops(), "cells": cells}
     os.makedirs(ARTIFACTS, exist_ok=True)
     with open(os.path.join(ARTIFACTS, "mfu_probe.json"), "w") as f:
         json.dump(out, f, indent=2)

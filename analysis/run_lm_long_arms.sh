@@ -1,0 +1,19 @@
+#!/bin/bash
+# VERDICT r3 item 3: extend the LM parity arms to the dense plateau.
+# Same protocol as the r3 runs (reproduce strings in
+# convergence_parity_lstm_ppl.json / convergence_parity_transformer.json),
+# only --steps extended; tags *_long so the r3 artifacts stay for diffing.
+set -x
+cd /root/repo
+python analysis/convergence_parity.py --arms none,gaussian,gaussian_warm \
+  --batch-size 2 --clip-norm 0.25 --compress-warmup-steps 20 \
+  --dataset ptb --dataset-kwargs '{"vocab_size": 16, "synthetic_order": 1, "bptt": 8, "synthetic_tokens_n": 32768}' \
+  --density 0.01 --devices 8 --dnn lstm --lr 1.0 \
+  --model-kwargs '{"embed_dim": 48, "hidden_dim": 48}' \
+  --outdir /tmp/gksgd_parity_lstm_long --seeds 2 --steps 3000 --tag lstm_ppl_long
+python analysis/convergence_parity.py --arms none,gaussian,randomk \
+  --batch-size 2 --compress-warmup-steps 20 \
+  --dataset ptb --dataset-kwargs '{"vocab_size": 16, "bptt": 16, "synthetic_tokens_n": 32768}' \
+  --density 0.01 --devices 8 --dnn transformer_lm --lr 0.05 \
+  --model-kwargs '{"dim": 32, "heads": 2, "num_layers": 2, "ffn": 64, "max_len": 16, "seq_len": 16, "dropout": 0.0}' \
+  --outdir /tmp/gksgd_parity_tf_long --seeds 2 --steps 2400 --tag transformer_long

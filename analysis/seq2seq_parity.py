@@ -1,0 +1,232 @@
+"""Config-5 convergence parity ON THE CONFIG-5 MODEL, with BLEU.
+
+VERDICT r3 item 4: the RandomK-vs-GaussianK contract (BASELINE config 5)
+was evidenced on a decoder-only LM proxy; this harness runs the arms on the
+actual encoder-decoder ``models/transformer.py`` with masked label-smoothed
+CE — the model ``exp_configs/config5*.json`` trains — over the synthetic
+WMT pairs (copy-reverse: exact targets, so greedy decode is scoreable),
+and adds translation-quality metrics: greedy-decode corpus BLEU and exact
+sequence match.
+
+Arms (default): dense | gaussian@density | randomk@density — the config-5
+comparison pair plus the baseline.
+
+Artifacts: analysis/artifacts/convergence_parity_seq2seq.json (+ curves
+jsonl, + png via plot_convergence conventions).
+
+Run: python analysis/seq2seq_parity.py [--steps 800] [--density 0.01]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from gaussiank_sgd_tpu import virtual_cpu  # noqa: E402
+
+ARTIFACTS = os.path.join(REPO, "analysis", "artifacts")
+
+
+def corpus_bleu(hyps, refs, max_n: int = 4) -> float:
+    """Corpus BLEU-4 (uniform weights, clipped modified n-gram precision,
+    brevity penalty) over integer-token sequences. Standard definition,
+    no smoothing — the copy-reverse task reaches exact matches, so zero
+    precisions only occur for genuinely broken models."""
+    p_num = [0] * max_n
+    p_den = [0] * max_n
+    hyp_len = ref_len = 0
+    for hyp, ref in zip(hyps, refs):
+        hyp_len += len(hyp)
+        ref_len += len(ref)
+        for n in range(1, max_n + 1):
+            hgrams = collections.Counter(
+                tuple(hyp[i:i + n]) for i in range(len(hyp) - n + 1))
+            rgrams = collections.Counter(
+                tuple(ref[i:i + n]) for i in range(len(ref) - n + 1))
+            p_num[n - 1] += sum(min(c, rgrams[g])
+                                for g, c in hgrams.items())
+            p_den[n - 1] += max(sum(hgrams.values()), 0)
+    if min(p_den) == 0 or min(p_num) == 0:
+        return 0.0
+    log_p = sum(math.log(p_num[i] / p_den[i]) for i in range(max_n)) / max_n
+    bp = 1.0 if hyp_len > ref_len else math.exp(1.0 - ref_len / max(hyp_len, 1))
+    return bp * math.exp(log_p)
+
+
+def greedy_decode(trainer, src, tgt_len: int):
+    """Greedy autoregressive decode with the trained encoder-decoder:
+    feed the argmax of position t back as decoder input t+1 (teacher
+    forcing replaced by model output — the standard greedy loop).
+    One jitted apply, tgt_len dispatches."""
+    import jax
+    import jax.numpy as jnp
+
+    spec = trainer.spec
+    params = trainer.state.params
+    mstate = trainer.state.model_state
+
+    apply = jax.jit(lambda d, s: spec.module.apply(
+        {"params": params, **mstate}, s, d, train=False))
+    b = src.shape[0]
+    dec = jnp.zeros((b, tgt_len), jnp.int32)   # BOS == pad id 0
+    src = jnp.asarray(src)
+    for t in range(tgt_len):
+        logits = apply(dec, src)
+        nxt = logits[:, t].argmax(-1).astype(jnp.int32)
+        if t + 1 < tgt_len:
+            dec = dec.at[:, t + 1].set(nxt)
+        last = nxt
+    # decoded sequence: positions 1..T-1 are dec, final token is `last`
+    out = jnp.concatenate([dec[:, 1:], last[:, None]], axis=1)
+    return jax.device_get(out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=800)
+    p.add_argument("--density", type=float, default=0.01)
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--seeds", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=2)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--seq-len", type=int, default=16)
+    p.add_argument("--vocab", type=int, default=32)
+    p.add_argument("--arms", default="none,gaussian,randomk")
+    p.add_argument("--decode-examples", type=int, default=128)
+    p.add_argument("--outdir", default="/tmp/gksgd_parity_s2s")
+    args = p.parse_args(argv)
+
+    virtual_cpu.provision(args.devices)
+    virtual_cpu.enable_compile_cache()
+    os.makedirs(ARTIFACTS, exist_ok=True)
+
+    import numpy as np
+
+    from gaussiank_sgd_tpu.data.synthetic import synthetic_seq2seq
+    from gaussiank_sgd_tpu.training.config import TrainConfig
+    from gaussiank_sgd_tpu.training.trainer import Trainer
+
+    seq = args.seq_len
+    common = dict(
+        dnn="transformer", dataset="wmt", batch_size=args.batch_size,
+        nworkers=args.devices, lr=args.lr, momentum=0.9, weight_decay=0.0,
+        label_smoothing=0.1, clip_norm=1.0,     # the config-5 loss settings
+        epochs=1, density=args.density, compress_warmup_steps=20,
+        warmup_epochs=0.0, compute_dtype="float32", output_dir=args.outdir,
+        log_every=25, eval_every_epochs=0, save_every_epochs=0,
+        model_kwargs={"dim": 32, "heads": 2, "enc_layers": 2,
+                      "dec_layers": 2, "ffn": 64, "max_len": seq,
+                      "seq_len": seq, "dropout": 0.0},
+        dataset_kwargs={"src_len": seq, "tgt_len": seq,
+                        "vocab_size": args.vocab},
+    )
+    # held-out pairs for decode scoring (val seed differs from train's)
+    val_src, val_ref = synthetic_seq2seq(args.decode_examples, seq, seq,
+                                         args.vocab, seed=1)
+
+    results = []
+    for arm in args.arms.split(","):
+        arm = arm.strip()
+        name = "dense" if arm == "none" else arm
+        runs = []
+        for s in range(args.seeds):
+            print(f"=== arm {name} seed {s} ===", flush=True)
+            cfg = TrainConfig(**common, compressor=arm, seed=s,
+                              max_steps=args.steps, run_id=f"{name}_s{s}")
+            t = Trainer(cfg)
+            t.train(args.steps)
+            res = t.test()
+            hyp = greedy_decode(t, val_src, seq)
+            hyps = [h.tolist() for h in hyp]
+            refs = [r.tolist() for r in val_ref]
+            bleu = corpus_bleu(hyps, refs)
+            exact = float(np.mean([h == r for h, r in zip(hyps, refs)]))
+            recs = [json.loads(l) for l in open(
+                os.path.join(t.run_dir, "metrics.jsonl"))]
+            tr = [r for r in recs if r.get("event") == "train"]
+            t.close()
+            runs.append({"val_loss": res["val_loss"],
+                         "token_top1": res.get("top1"),
+                         "bleu": round(bleu, 4),
+                         "exact_match": round(exact, 4),
+                         "final_loss": tr[-1]["loss"],
+                         "bytes_per_step": tr[-1]["bytes_sent"],
+                         "curve": [(r["step"], r["loss"]) for r in tr]})
+            print(f"{name} s{s}: val_loss={res['val_loss']:.4f} "
+                  f"bleu={bleu:.4f} exact={exact:.4f}", flush=True)
+        agg = lambda key: {
+            "mean": round(float(np.mean([r[key] for r in runs])), 4),
+            "std": round(float(np.std([r[key] for r in runs])), 4),
+            "values": [round(float(r[key]), 4) for r in runs]}
+        results.append({
+            "arm": name, "compressor": arm,
+            "val_loss": agg("val_loss"), "token_top1": agg("token_top1"),
+            "bleu": agg("bleu"), "exact_match": agg("exact_match"),
+            "bytes_per_step": runs[0]["bytes_per_step"],
+            "curve": runs[0]["curve"],
+        })
+
+    dense = next((r for r in results if r["compressor"] == "none"), None)
+    summary = {
+        "config": {"model": "transformer (encoder-decoder, masked "
+                            "label-smoothed CE) — the exp_configs/config5 "
+                            "model", "steps": args.steps,
+                   "density": args.density, "nworkers": args.devices,
+                   "seeds": args.seeds, "seq_len": seq,
+                   "vocab": args.vocab,
+                   "task": "synthetic copy-reverse (exact targets)",
+                   "reproduce": "python analysis/seq2seq_parity.py "
+                                + " ".join(f"--{k.replace('_', '-')} {v}"
+                                           for k, v in sorted(
+                                               vars(args).items())
+                                           if v is not None)},
+        "arms": [{k: r[k] for k in ("arm", "compressor", "val_loss",
+                                    "token_top1", "bleu", "exact_match",
+                                    "bytes_per_step")} for r in results],
+    }
+    if dense is not None:
+        summary["parity"] = {
+            r["arm"]: {
+                "bleu_gap_vs_dense": round(
+                    dense["bleu"]["mean"] - r["bleu"]["mean"], 4),
+                "val_loss_ratio_vs_dense": round(
+                    r["val_loss"]["mean"] / dense["val_loss"]["mean"], 4),
+            } for r in results if r is not dense}
+    with open(os.path.join(ARTIFACTS,
+                           "convergence_parity_seq2seq.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    with open(os.path.join(ARTIFACTS,
+                           "convergence_parity_seq2seq_curves.jsonl"),
+              "w") as f:
+        for r in results:
+            f.write(json.dumps({"arm": r["arm"], "curve": r["curve"]}) + "\n")
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        fig, ax = plt.subplots(figsize=(7, 4.5))
+        for r in results:
+            xs, ys = zip(*r["curve"])
+            ax.plot(xs, ys, label=f"{r['arm']} "
+                                  f"(BLEU {r['bleu']['mean']:.3f})")
+        ax.set_xlabel("step"); ax.set_ylabel("train loss")
+        ax.set_title(f"config-5 seq2seq: dense vs gaussian vs randomk, "
+                     f"density={args.density}, {args.devices}-way")
+        ax.legend(); fig.tight_layout()
+        fig.savefig(os.path.join(ARTIFACTS,
+                                 "convergence_parity_seq2seq.png"), dpi=120)
+    except Exception as e:
+        print(f"(no plot: {e})")
+    print(json.dumps(summary, indent=2)[:2000])
+    return summary
+
+
+if __name__ == "__main__":
+    main()

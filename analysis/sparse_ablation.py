@@ -33,76 +33,60 @@ sys.path.insert(0, REPO)
 ARTIFACTS = os.path.join(REPO, "analysis", "artifacts")
 
 
-def _ablation_specs():
-    import jax
-    import jax.numpy as jnp
-
-    from gaussiank_sgd_tpu.compressors.base import (CompressedGrad,
-                                                    CompressResult)
-    from gaussiank_sgd_tpu.compressors.registry import CompressorSpec
-
-    def ef_only(acc, k, rng=None):
-        idx = jnp.arange(k, dtype=jnp.int32)
-        val = acc[:k]
-        # residual untouched minus the sent slice: one k-sized scatter
-        residual = acc.at[idx].set(0.0)
-        return CompressResult(CompressedGrad(idx, val), residual,
-                              jnp.asarray(k, jnp.int32))
-
-    def sel_nores(acc, k, rng=None):
-        mag = jnp.abs(acc).astype(jnp.bfloat16)
-        _, idx = jax.lax.approx_max_k(mag, k, recall_target=0.95)
-        idx = idx.astype(jnp.int32)
-        val = acc[idx]
-        # measurement-only: residual deliberately skips the scatter-copy
-        return CompressResult(CompressedGrad(idx, val), acc,
-                              jnp.asarray(k, jnp.int32))
-
-    return {
-        "ef_only": CompressorSpec("ef_only", ef_only, False, True,
-                                  lambda k: k),
-        "sel_nores": CompressorSpec("sel_nores", sel_nores, False, True,
-                                    lambda k: k),
-    }
-
-
 def main(argv=None):
-    import gaussiank_sgd_tpu.compressors as comps
+    # the ef_only/sel_nores prefix probes live in benchlib.ablation_specs
+    # (shared with analysis/bench_matrix.py's per-cell phase columns);
+    # bench_model resolves their names directly
+    from gaussiank_sgd_tpu import virtual_cpu
     from gaussiank_sgd_tpu.benchlib import bench_model
 
-    specs = _ablation_specs()
-    real_get = comps.get_compressor
+    # persistent compile cache (works for the TPU backend too): a re-run
+    # in a better drift window must not pay the ~20-min 57M-param compile
+    # bill again
+    virtual_cpu.enable_compile_cache("/tmp/gksgd_tpu_cache")
 
-    def patched(name, **kw):
-        return specs.get(name) or real_get(name, **kw)
+    import statistics
 
-    comps.get_compressor = patched
-    try:
-        names = ("ef_only", "sel_nores", "approxtopk16", "gaussian_warm")
-        times = bench_model("transformer", "wmt", 64, 0.001, names,
-                            n_steps=10, rounds=4)
-    finally:
-        comps.get_compressor = real_get
+    names = ("ef_only", "sel_nores", "approxtopk16", "gaussian_warm",
+             "gaussian_fused")
+    times = bench_model("transformer", "wmt", 64, 0.001, names,
+                        n_steps=10, rounds=6)
 
     dense = times["dense"]
     ms = {k: round(1e3 * v, 3) for k, v in times.items()
           if isinstance(v, float) and not k.startswith("_")}
+
+    # PAIRED per-round deltas (r4 fix): min-of-rounds per variant can land
+    # different variants in different drift regimes of the shared chip and
+    # produce physically impossible (negative) decompositions — the first
+    # r4 run did exactly that. Every variant runs inside every round, so
+    # the median over rounds of (a_r - b_r) is drift-robust.
+    rnds = times["_rounds"]
+
+    def delta_ms(a, b):
+        per_round = [1e3 * (x - y) for x, y in zip(rnds[a], rnds[b])]
+        return round(statistics.median(per_round), 3)
+
     out = {
         "model": "transformer 57M, b=64, density 0.001",
         "ms": ms,
         "decomposition_ms": {
             "dense_fwd_bwd_update": ms["dense"],
-            "ef_exchange_floor": round(ms["ef_only"] - ms["dense"], 3),
-            "abs_cast_select_gather": round(
-                ms["sel_nores"] - ms["ef_only"], 3),
-            "residual_scatter_copy": round(
-                ms["approxtopk16"] - ms["sel_nores"], 3),
-            "warm_mask_pack_total": round(
-                ms["gaussian_warm"] - ms["ef_only"], 3),
+            "ef_exchange_floor": delta_ms("ef_only", "dense"),
+            "abs_cast_select_gather": delta_ms("sel_nores", "ef_only"),
+            "residual_scatter_copy": delta_ms("approxtopk16", "sel_nores"),
+            "warm_mask_pack_total": delta_ms("gaussian_warm", "ef_only"),
+            # the r4 north-star kernel (ops/pallas_pack.py): fused
+            # select+pack overhead over the same EF+exchange floor
+            "fused_kernel_pack_total": delta_ms("gaussian_fused", "ef_only"),
+            "fused_total_overhead_vs_dense": delta_ms("gaussian_fused",
+                                                      "dense"),
+            "warm_total_overhead_vs_dense": delta_ms("gaussian_warm",
+                                                     "dense"),
         },
-        "ratios": {k: round(dense / times[k], 4) for k in
-                   ("ef_only", "sel_nores", "approxtopk16",
-                    "gaussian_warm")},
+        "methodology": "median over rounds of per-round paired deltas; "
+                       "every variant timed inside every rotated round",
+        "ratios": {k: round(dense / times[k], 4) for k in names},
     }
     os.makedirs(ARTIFACTS, exist_ok=True)
     with open(os.path.join(ARTIFACTS, "sparse_ablation.json"), "w") as f:

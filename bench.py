@@ -4,13 +4,13 @@ Headline metric (BASELINE north-star, SURVEY.md §6): sparse-step throughput
 as a fraction of dense-step throughput on the same model/batch, target
 >= 0.90 ("sparse must not lose to dense").
 
-De-cherry-picked per VERDICT r2 item 6: the headline is the MEDIAN-of-rounds
-ratio for ONE fixed, named selector (gaussian_warm — the warm-started
-GaussianK threshold, the framework's TPU-native flagship and the only
-selector measured >=0.91 on every config in the r3 matrix; the approxtopk
-family wins some models but drops to ~0.72-0.80 on VGG-16 in slow chip
-windows) on the flagship ResNet-20 config; min-of-rounds and the
-best-of-3-selectors winner are reported as SECONDARY fields. detail.configs carries the same
+De-cherry-picked per VERDICT r2 item 6 and r3 item 2: the headline is the
+MEDIAN-of-rounds ratio for THE framework's ex-ante default selector —
+``compressors.registry.DEFAULT_SELECTOR`` (gaussian_fused: warm-started
+GaussianK threshold + the Pallas fused select+pack kernel,
+ops/pallas_pack.py) — the policy a user inherits without measuring, not a
+per-window winner. Min-of-rounds and the best-of-3-selectors winner are
+reported as SECONDARY fields. detail.configs carries the same
 fixed-selector median/min ratio plus MFU for ALL FIVE BASELINE configs with
 per-round dispersion, so no favorable cell can carry the number.
 
@@ -27,8 +27,10 @@ import statistics
 
 import jax
 
-FIXED = "gaussian_warm"         # the fixed headline selector
-SWEEP = ("gaussian_warm", "approxtopk16", "approxtopk")
+from gaussiank_sgd_tpu.compressors import DEFAULT_SELECTOR
+
+FIXED = DEFAULT_SELECTOR        # the codified ex-ante policy (registry.py)
+SWEEP = (FIXED, "gaussian_warm", "approxtopk16")
 
 # (key, model, dataset, per-chip batch, n_steps, rounds)
 CONFIGS = (
@@ -36,7 +38,9 @@ CONFIGS = (
     ("vgg16", "vgg16", "cifar10", 256, 20, 5),
     ("resnet50", "resnet50", "imagenet", 64, 10, 4),
     ("lstm_ptb", "lstm", "ptb", 160, 10, 4),
-    ("transformer_wmt", "transformer", "wmt", 64, 10, 4),
+    # b32 = the exp_configs/config5*.json per-chip batch (VERDICT r3 item 8:
+    # bench and training config must share one operating point)
+    ("transformer_wmt", "transformer", "wmt", 32, 10, 4),
 )
 
 
@@ -55,7 +59,12 @@ def _ratios(times, name):
 
 
 def main():
+    from gaussiank_sgd_tpu import virtual_cpu
     from gaussiank_sgd_tpu.benchlib import bench_model, mfu
+
+    # persistent compile cache: repeated driver runs skip the multi-minute
+    # 20-60M-param compiles (drift windows change, programs don't)
+    virtual_cpu.enable_compile_cache("/tmp/gksgd_tpu_cache")
 
     density = 0.001
     detail_configs = {}
@@ -102,7 +111,8 @@ def main():
         "unit": "ratio",
         "vs_baseline": round(value / 0.90, 4),
         "detail": {
-            "headline": f"median-of-rounds ratio, fixed selector {FIXED}, "
+            "headline": f"median-of-rounds ratio, ex-ante default selector "
+                        f"{FIXED} (registry.DEFAULT_SELECTOR policy), "
                         f"resnet20/b1024, density {density}",
             "worst_config_ratio_median": worst["ratio_median"],
             "configs": detail_configs,

@@ -67,6 +67,49 @@ def mfu(flops_per_step: Optional[float], step_seconds: float,
     return flops_per_step / (step_seconds * peak)
 
 
+def ablation_specs():
+    """Probe compressors that run a PREFIX of the sparse pipeline, for
+    drift-free phase decomposition (VERDICT r3 item 6; the reference
+    logged io/fwd/bwd/comm per display interval — SURVEY.md §5 Tracing).
+
+    ``ef_only``  — EF accumulate + exchange of a fixed k-slice (no
+                   selection): the floor every sparse step pays. Its delta
+                   over the dense step is the exchange cost; a real
+                   selector's delta over it is the select+pack cost.
+    ``sel_nores`` — + abs/cast/approx_max_k/gather but NO residual
+                   scatter (EF-INCORRECT, measurement only).
+
+    Both are bench probes, not registry entries: they must never be
+    reachable from training configs.
+    """
+    import jax
+
+    from .compressors.base import CompressedGrad, CompressResult
+    from .compressors.registry import CompressorSpec
+
+    def ef_only(acc, k, rng=None):
+        idx = jnp.arange(k, dtype=jnp.int32)
+        val = acc[:k]
+        residual = acc.at[idx].set(0.0)
+        return CompressResult(CompressedGrad(idx, val), residual,
+                              jnp.asarray(k, jnp.int32))
+
+    def sel_nores(acc, k, rng=None):
+        mag = jnp.abs(acc).astype(jnp.bfloat16)
+        _, idx = jax.lax.approx_max_k(mag, k, recall_target=0.95)
+        idx = idx.astype(jnp.int32)
+        val = acc[idx]
+        return CompressResult(CompressedGrad(idx, val), acc,
+                              jnp.asarray(k, jnp.int32))
+
+    return {
+        "ef_only": CompressorSpec("ef_only", ef_only, False, True,
+                                  lambda k: k),
+        "sel_nores": CompressorSpec("sel_nores", sel_nores, False, True,
+                                    lambda k: k),
+    }
+
+
 def make_batch(spec, batch_size: int, rng=None):
     """Synthesize a (x, y) batch matching the model task's shapes."""
     rng = jax.random.PRNGKey(0) if rng is None else rng
@@ -137,10 +180,11 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
     batch = shard_batch(mesh, (x, y))
     carry = (spec.module.initial_carry(batch_size) if recurrent else ())
 
+    probes = ablation_specs()
     programs = {}
     dense_ts = dense_mk = None
     for name in compressors:
-        comp = get_compressor(name, density=density)
+        comp = probes.get(name) or get_compressor(name, density=density)
         ts = build_dp_train_step(
             make_loss_fn(spec, recurrent=recurrent),
             optax.sgd(0.1, momentum=0.9), comp, plan, mesh,

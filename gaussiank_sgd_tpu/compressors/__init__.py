@@ -5,11 +5,13 @@ from .base import (CompressedGrad, CompressResult, bisect_threshold,
 from .exact import none_compress, topk_compress
 from .gaussian import gaussian_threshold_estimate, gaussiank_compress
 from .randomk import randomk_compress, randomkec_compress
-from .registry import NAMES, CompressorSpec, get_compressor
+from .registry import (DEFAULT_SELECTOR, NAMES, CompressorSpec,
+                       default_selector, get_compressor)
 from .sampling import dgc_compress, redsync_compress, redsynctrim_compress
 
 __all__ = [
-    "CompressedGrad", "CompressResult", "CompressorSpec", "NAMES",
+    "CompressedGrad", "CompressResult", "CompressorSpec",
+    "DEFAULT_SELECTOR", "NAMES", "default_selector",
     "bisect_threshold", "decompress", "dgc_compress",
     "gaussian_threshold_estimate", "gaussiank_compress", "get_compressor",
     "k_for", "none_compress", "pack_by_mask", "pack_by_threshold",

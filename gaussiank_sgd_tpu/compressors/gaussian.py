@@ -124,7 +124,8 @@ def gaussian_warm_compress_batched(x: jax.Array, k: int, state: jax.Array,
                                    sigma_scale: Optional[float] = None,
                                    gain: float = 0.18,
                                    ) -> tuple[CompressResult, jax.Array]:
-    """gaussian_warm over ``[n_chunks, chunk]`` with ONE scalar warm/cold cond.
+    """gaussian_warm over ``[n_chunks, chunk]`` with PER-LANE cold recovery
+    behind one scalar cond.
 
     Why this exists (ADVICE r2, medium): vmapping :func:`gaussian_warm_compress`
     lowers its per-lane ``lax.cond`` to ``lax.select``, which executes BOTH
@@ -132,14 +133,23 @@ def gaussian_warm_compress_batched(x: jax.Array, k: int, state: jax.Array,
     step for every chunk, silently destroying the zero-search-pass property
     exactly in the scalable ``bucket_policy='uniform'`` configuration.
 
-    Here the decision is a single scalar ``all(usable)`` predicate wrapping the
-    whole batch: the steady-state program is ONLY the vmapped warm path (one
-    threshold-mask pass + pack per chunk). When ANY chunk needs recovery the
-    cold branch re-estimates thresholds for ALL chunks that step (warm lanes
-    get a fresh — equally valid — threshold; EF bookkeeping is exact either
-    way, and the per-chunk controller resumes from the fresh value). Cold
-    steps are a transient (first step, or after a gradient shock), so paying
-    the full estimate on every lane there costs nothing in steady state.
+    Recovery structure (reworked per ADVICE r3: the r2 version replayed the
+    cold path on ALL lanes whenever ANY lane left the count band, so one
+    persistently-cold chunk — e.g. a near-empty gradient — forced the
+    10-pass bisection every step for the whole batch and reset healthy
+    lanes' thresholds):
+
+      * steady state (every lane usable): the program is ONLY the vmapped
+        mask + magnitude pack — zero search passes;
+      * recovery (scalar ``any(~usable)`` cond): the estimate+bisection runs
+        vmapped, but each lane adopts the fresh threshold ONLY if it was
+        unusable — warm lanes keep their carried thresholds and their
+        controller trajectory. A lane that stays outside the band pays the
+        bisection again next step, but no longer drags the others with it.
+
+    Both branches end in the shared magnitude-priority pack (the warm one
+    reusing the count pass's mask, the recovery one re-masking with its
+    per-lane ``t_eff``), so EF bookkeeping is exact everywhere.
     """
     abs_x = jnp.abs(x)
     mask_prev = abs_x > state[:, None]           # ONE pass over the buffer
@@ -147,19 +157,25 @@ def gaussian_warm_compress_batched(x: jax.Array, k: int, state: jax.Array,
     usable = (state > 0) & (count_prev >= k // 4) & (count_prev <= 4 * k)
 
     def warm(_):
+        # steady state: pack with the mask the count pass already built —
+        # no second full-buffer compare (code-review r4)
         res = jax.vmap(lambda xc, mc: pack_by_mask(
             xc, mc, k, priority="magnitude"))(x, mask_prev)
         return res, state
 
-    def cold(_):
+    def recover(_):
         def one(xc, ac):
             t0 = gaussian_threshold_estimate(xc, density, sigma_scale)
-            t = bisect_threshold(ac, k, t0, num_iters=10)
-            return pack_by_threshold(xc, t, k), t
+            return bisect_threshold(ac, k, t0, num_iters=10)
 
-        return jax.vmap(one)(x, abs_x)
+        t_fresh = jax.vmap(one)(x, abs_x)
+        t_eff = jnp.where(usable, state, t_fresh)
+        res = jax.vmap(lambda xc, ac, tc: pack_by_mask(
+            xc, ac > tc, k, priority="magnitude"))(x, abs_x, t_eff)
+        return res, t_eff
 
-    result, t = jax.lax.cond(jnp.all(usable), warm, cold, operand=None)
+    result, t_eff = jax.lax.cond(jnp.all(usable), warm, recover,
+                                 operand=None)
     ratio = (result.num_selected.astype(jnp.float32) + 1.0) / float(k + 1)
-    t_new = t * jnp.clip(ratio ** gain, 0.25, 4.0)
+    t_new = t_eff * jnp.clip(ratio ** gain, 0.25, 4.0)
     return result, t_new

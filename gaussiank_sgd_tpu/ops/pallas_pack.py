@@ -1,0 +1,283 @@
+"""Pallas fused threshold-select + pack kernel — packed (index, value) pairs.
+
+Reference parity: the north-star deliverable (BASELINE.json ``north_star``,
+SURVEY.md §7 stage 6): the reference's ``GaussianCompressor`` select+pack
+(``compression.py``) re-built as a TPU kernel that *emits packed (index,
+value) pairs* instead of composing XLA sort/select primitives.
+
+Why it exists (measured, analysis/artifacts/sparse_ablation.json r3): at 57M
+params the XLA pack (`abs` + bf16 key + ``lax.approx_max_k`` + gather) costs
+6.5-8.6 ms — ~3-4x over raw HBM-bandwidth theory, and the dominant term of
+the whole sparse-step overhead. A threshold select is informationally one
+pass: read each element once, keep the few that cross ``t``. The obstacle on
+TPU is *compaction* — the VPU has no efficient scatter, so "move the selected
+entries to the front" is the expensive part, and an n-sized XLA scatter
+serializes (~93 ms at 15M, r3 memory). This kernel solves compaction with a
+TPU-shaped two-level scheme:
+
+  1. **In-kernel (one HBM pass)**: the flat buffer is viewed as
+     ``[rows, 128]`` and gridded into blocks of ``R`` rows. Each of the 128
+     lanes of a block owns a column of ``R`` elements. Per block the kernel
+     extracts the top-``S`` above-threshold entries *of each column* into a
+     fixed ``[S, 128]`` output tile (value + flat index), using S sublane
+     max-reductions over an int32 ranking key. The key is the f32 magnitude's
+     bit pattern with its low 11 mantissa bits replaced by the row index —
+     order-preserving to ~2^-12 relative, and it makes every key in a column
+     unique, so the winner is identified by ONE max-reduction (no tie-break
+     pass) and its row recovered from the key's low bits. The exact f32 value
+     is then recovered with a masked sum over the winner's one-hot.
+     Everything runs on VMEM-resident data: HBM traffic is exactly one read
+     of the buffer plus the (tiny) candidate tiles.
+  2. **In-XLA (small)**: the candidate buffer has ``nc = S*n/R`` slots —
+     256x smaller than the gradient at the contract density — so an *exact*
+     ``lax.top_k`` over candidate magnitudes picks the final k pairs in
+     f32 (strictly better truncation than the bf16 approx_max_k key the XLA
+     composite needs at n-scale).
+
+Selection contract vs ``pack_by_mask(priority="magnitude")``: identical mask
+(``|acc| > t``), identical exact EF bookkeeping (the caller zeroes exactly
+the k sent entries; everything else — including any entry beyond a column's
+S-slot cap — stays in the residual and is re-selected next step). The
+geometry (R, S) is chosen so the per-column above-threshold count lambda =
+R*density keeps cap overflow below ~1% of selected entries at supported
+densities; overflow loses nothing (EF), it only defers.
+
+``num_selected`` is the exact above-threshold count, accumulated in SMEM
+across the (sequential) grid — the same observability the reference logs.
+
+Off-TPU the kernel runs in interpret mode (tests/conftest.py CPU mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports cleanly only where libtpu/mosaic is available
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+from ..compressors.base import (_EXACT_PACK_MAX, CompressedGrad,
+                                CompressResult)
+
+_LANES = 128
+_S = 8            # candidate slots per block-column (= one f32 sublane tile)
+_ROW_BITS = 11    # low mantissa bits of the key carry the row id (R <= 2048)
+_ROW_MASK = (1 << _ROW_BITS) - 1
+
+
+def rows_per_block(density: float) -> int:
+    """Reduction span R by density so lambda = R*density stays ~<= 2.
+
+    Cap overflow per column is Poisson: P(X > S | lambda). With S=8,
+    R=1024 @ density 0.002 gives lambda ~2.05 (overflow ~1e-4 of columns);
+    R=256 @ density 0.02 gives lambda ~5.1 (overflow ~7%, still EF-safe).
+    Above density 0.05 the candidate buffer stops being small — callers
+    should use the XLA pack instead (see supports_density).
+    """
+    if density <= 0.002:
+        return 1024
+    if density <= 0.05:
+        return 256
+    raise ValueError(
+        f"fused select+pack supports density <= 0.05, got {density}")
+
+
+def supports_density(density: float) -> bool:
+    return density <= 0.05
+
+
+def _select_kernel(x_ref, t_ref, val_ref, idx_ref, count_ref, *, rows: int):
+    """One grid step: extract top-S above-threshold entries per column.
+
+    x_ref: [R, 128] f32 block of the flat buffer.
+    t_ref: [1, 1] f32 threshold in SMEM.
+    val_ref/idx_ref: [S, 128] candidate tiles for this block.
+    count_ref: [1, 1] i32 SMEM accumulator (exact above-threshold count),
+    carried across the sequential grid.
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        count_ref[0, 0] = 0
+
+    x = x_ref[:]
+    ax = jnp.abs(x)
+    t = t_ref[0, 0]
+    mask = ax > t
+    count_ref[0, 0] += jnp.sum(mask.astype(jnp.int32))
+
+    rowid = lax.broadcasted_iota(jnp.int32, (rows, _LANES), 0)
+    lane = lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
+    # int32 ranking key: positive-f32 bit pattern (int compare == float
+    # compare for non-negative floats), low bits replaced by the row id so
+    # every in-column key is unique. 0 = "not selected" sentinel; a selected
+    # element whose magnitude bits round to 0 (subnormal ~<1e-42 in row 0)
+    # would collide with the sentinel and stay in the residual — harmless.
+    bits = lax.bitcast_convert_type(ax, jnp.int32)
+    key = jnp.where(mask, (bits & ~_ROW_MASK) | rowid, 0)
+
+    base = i * rows  # first flat row of this block
+    for s in range(_S):
+        top = jnp.max(key, axis=0, keepdims=True)          # [1, 128]
+        win = key == jnp.broadcast_to(top, key.shape)      # one-hot per col
+        win = win & (top > 0)
+        val = jnp.sum(jnp.where(win, x, 0.0), axis=0, keepdims=True)
+        r_win = top & _ROW_MASK
+        flat_idx = (base + r_win) * _LANES + lane
+        valid = top > 0
+        val_ref[s, :] = jnp.where(valid, val, 0.0)[0]
+        idx_ref[s, :] = jnp.where(valid, flat_idx, 0)[0]
+        key = jnp.where(win, 0, key)
+
+
+def fused_select_candidates(
+    acc: jax.Array, threshold: jax.Array, density: float,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One kernel pass: (cand_values [nc], cand_indices [nc], count).
+
+    ``acc`` is the flat f32 EF accumulator; candidates are the top-S
+    above-threshold entries of each [R]-row column (see module docstring).
+    Invalid slots hold (value 0, index 0). The zero-padding the reshape
+    needs is produced by XLA and fuses into whatever computed ``acc``.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = acc.shape[0]
+    R = rows_per_block(density)
+    block = R * _LANES
+    n_pad = -(-n // block) * block
+    # pad with zeros: a zero can never cross a positive threshold, and the
+    # warm path guards t > 0 (t <= 0 routes to the cold estimator anyway)
+    x = jnp.pad(acc.astype(jnp.float32), (0, n_pad - n)).reshape(-1, _LANES)
+    n_blocks = x.shape[0] // R
+
+    space = pltpu.VMEM if (_HAS_PLTPU and not interpret) else None
+    smem = pltpu.SMEM if (_HAS_PLTPU and not interpret) else None
+    vals, idxs, count = pl.pallas_call(
+        functools.partial(_select_kernel, rows=R),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((R, _LANES), lambda i: (i, 0), memory_space=space),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=smem),
+        ],
+        out_specs=(
+            pl.BlockSpec((_S, _LANES), lambda i: (0, i), memory_space=space),
+            pl.BlockSpec((_S, _LANES), lambda i: (0, i), memory_space=space),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=smem),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((_S, n_blocks * _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((_S, n_blocks * _LANES), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        interpret=interpret,
+    )(x, threshold.astype(jnp.float32).reshape(1, 1))
+    return vals.reshape(-1), idxs.reshape(-1), count[0, 0]
+
+
+def _cand_top_k(vals: jax.Array, k: int):
+    """Exact f32 top-k over the candidate magnitudes when the buffer is
+    small enough (it is at all supported densities <= 0.02 on <= ~60M
+    params), approx_max_k beyond — same switch as base.pack_by_mask."""
+    key = jnp.abs(vals)
+    if vals.shape[0] <= _EXACT_PACK_MAX:
+        return lax.top_k(key, k)
+    return lax.approx_max_k(key, k, recall_target=0.95)
+
+
+def fused_select_pack(acc: jax.Array, k: int, threshold: jax.Array,
+                      density: float,
+                      interpret: Optional[bool] = None) -> CompressResult:
+    """Threshold-select ``|acc| > threshold`` packed to exactly k pairs.
+
+    Drop-in for ``pack_by_threshold`` (same CompressResult contract: exactly
+    k slots, (0, 0) padding, exact EF residual) with the selection done by
+    the fused kernel + an exact f32 top-k over the small candidate buffer.
+    Truncation beyond k drops smallest-magnitude candidates — the
+    ``pack_by_mask(priority="magnitude")`` contract.
+    """
+    n = acc.shape[0]
+    vals, idxs, count = fused_select_candidates(acc, threshold, density,
+                                                interpret)
+    nc = vals.shape[0]
+    if k > nc:  # geometry guarantees nc >= ~1.5k at supported densities;
+        # unreachable for k = ceil(density*n), but fail loud for direct calls
+        raise ValueError(f"k={k} exceeds candidate capacity {nc} "
+                         f"(n={n}, density={density})")
+    kv, kpos = _cand_top_k(vals, k)
+    valid = kv > 0
+    idx = jnp.where(valid, idxs[kpos], 0).astype(jnp.int32)
+    val = jnp.where(valid, vals[kpos], 0.0).astype(acc.dtype)
+    sent_idx = jnp.where(valid, idx, n)
+    residual = acc.at[sent_idx].set(0.0, mode="drop")
+    return CompressResult(CompressedGrad(idx, val), residual, count)
+
+
+def gaussian_fused_compress(acc: jax.Array, k: int, state: jax.Array,
+                            rng: Optional[jax.Array] = None,
+                            *, density: float = 0.001,
+                            sigma_scale: Optional[float] = None,
+                            gain: float = 0.18,
+                            interpret: Optional[bool] = None,
+                            ) -> Tuple[CompressResult, jax.Array]:
+    """gaussian_warm with the fused Pallas select+pack on the hot path.
+
+    Same stateful contract as ``gaussian_warm_compress``
+    (compressors/gaussian.py): the threshold is carried across steps, the
+    multiplicative controller nudges it toward count == k, and a cold start
+    (state <= 0 or count outside [k/4, 4k]) falls back to the full Gaussian
+    estimate + bisection for that step. Differences on the warm path:
+
+      * selection+pack is ONE kernel pass + a small exact top-k, instead of
+        a mask pass + n-scale bf16 approx_max_k + gather;
+      * the above-threshold count used by the controller comes from the
+        kernel (exact), not from a separate mask reduction.
+    """
+    from ..compressors.base import bisect_threshold, pack_by_threshold
+    from ..compressors.gaussian import (gaussian_threshold_estimate,
+                                        gaussian_warm_compress)
+
+    n = acc.shape[0]
+    R = rows_per_block(density)
+    nc = _S * (-(-n // (R * _LANES))) * _LANES
+    if k > nc:
+        # trace-time geometry check: only reachable for direct calls with a
+        # k far above ceil(density*n) — route to the XLA warm path instead
+        # of producing a truncated-below-k pack
+        return gaussian_warm_compress(acc, k, state, rng, density=density,
+                                      sigma_scale=sigma_scale, gain=gain)
+
+    vals, idxs, count = fused_select_candidates(acc, state, density,
+                                                interpret)
+    usable = (state > 0) & (count >= k // 4) & (count <= 4 * k)
+
+    def warm(_):
+        kv, kpos = _cand_top_k(vals, k)
+        valid = kv > 0
+        idx = jnp.where(valid, idxs[kpos], 0).astype(jnp.int32)
+        val = jnp.where(valid, vals[kpos], 0.0).astype(acc.dtype)
+        residual = acc.at[jnp.where(valid, idx, n)].set(0.0, mode="drop")
+        return CompressResult(CompressedGrad(idx, val), residual,
+                              count), state
+
+    def cold(_):
+        abs_acc = jnp.abs(acc)
+        t0 = gaussian_threshold_estimate(acc, density, sigma_scale)
+        t = bisect_threshold(abs_acc, k, t0, num_iters=10)
+        return pack_by_threshold(acc, t, k), t
+
+    result, t = lax.cond(usable, warm, cold, operand=None)
+    ratio = (result.num_selected.astype(jnp.float32) + 1.0) / float(k + 1)
+    t_new = t * jnp.clip(ratio ** gain, 0.25, 4.0)
+    return result, t_new

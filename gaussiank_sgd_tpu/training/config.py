@@ -84,6 +84,11 @@ class TrainConfig:
     save_every_epochs: int = 10
     resume: Optional[str] = None            # checkpoint dir to resume from
     profile_steps: Optional[Tuple[int, int]] = None  # jax.profiler window
+    phase_timing: bool = True               # fwd/bwd + select + comm ms in
+                                            # every log line (the reference's
+                                            # per-interval io/fwd/bwd/comm
+                                            # breakdown, SURVEY.md §5); two
+                                            # probe dispatches per interval
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), default=str, indent=2)
@@ -142,8 +147,10 @@ def add_args(p: argparse.ArgumentParser, suppress_defaults: bool = False) -> Non
                         "(reference repackaging); --no-carry-hidden = fresh "
                         "zero carry per window")
     p.add_argument("--compressor", default=d.compressor,
-                   help="none|topk|gaussian|randomk|randomkec|dgcsampling|"
-                        "redsync|redsynctrim")
+                   help="none|topk|approxtopk[16]|gaussian|gaussian_warm|"
+                        "gaussian_fused|randomk|randomkec|dgcsampling|"
+                        "redsync|redsynctrim — or 'auto' for the codified "
+                        "framework default (registry.DEFAULT_SELECTOR)")
     p.add_argument("--density", type=float, default=d.density)
     p.add_argument("--sigma-scale", dest="sigma_scale", type=float,
                    default=None)

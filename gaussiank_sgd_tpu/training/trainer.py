@@ -265,6 +265,7 @@ class Trainer:
             self.timers.start("io")
             batch = next(it)
             batch = shard_batch(self.mesh, batch, spec=self._batch_spec)
+            self._probe_batch = batch      # for _phase_breakdown at log time
             self.timers.start("step")
             step = self.step if not hasattr(self, "_step_cache") else \
                 self._step_cache
@@ -311,6 +312,39 @@ class Trainer:
             skip = 0
             ep += 1
 
+    def _phase_breakdown(self, step_s: float) -> Dict[str, float]:
+        """fwd/bwd, select+pack, and comm+update ms for the CURRENT state —
+        the reference's per-interval io/fwd/bwd/comm log breakdown
+        (SURVEY.md §5 Tracing row, VERDICT r3 item 6). Times two jitted
+        prefix programs of the sparse step on the last batch; comm+update
+        is the full step's remainder. Single-dispatch timings through the
+        tunnel are logging-grade — benchmark-grade phase numbers come from
+        analysis/bench_matrix.py's paired-round probe columns."""
+        if getattr(self, "_probe_batch", None) is None:
+            return {}          # nothing trained yet this process
+        if not hasattr(self, "_probes"):
+            self._probes = self.ts.make_probes()
+            # compile OUTSIDE the timed windows: the first timed call would
+            # otherwise report jit compilation (seconds-to-minutes at 57M)
+            # as fb=/sel= phase time (code-review r4)
+            for fn in self._probes.values():
+                jax.block_until_ready(fn(self.state, self._probe_batch))
+        t0 = time.perf_counter()
+        jax.block_until_ready(self._probes["grads"](self.state,
+                                                    self._probe_batch))
+        t_grads = time.perf_counter() - t0
+        out = {"fwd_bwd_s": round(t_grads, 6)}
+        if not self._in_warmup(self.step):
+            t0 = time.perf_counter()
+            jax.block_until_ready(self._probes["select"](self.state,
+                                                         self._probe_batch))
+            t_sel = time.perf_counter() - t0
+            out["select_s"] = round(max(t_sel - t_grads, 0.0), 6)
+            out["comm_update_s"] = round(max(step_s - t_sel, 0.0), 6)
+        else:
+            out["comm_update_s"] = round(max(step_s - t_grads, 0.0), 6)
+        return out
+
     def _log_train(self, step: int, m, quiet: bool = False):
         loss = float(jax.device_get(m.loss))
         means = self.timers.means()
@@ -324,15 +358,23 @@ class Trainer:
             "density": self.cfg.density,
             "io_s": means.get("io", 0.0), "step_s": means.get("step", 0.0),
         }
+        if self.cfg.phase_timing and not quiet:
+            rec.update(self._phase_breakdown(rec["step_s"]))
         aux = jax.device_get(m.aux)
         rec.update({k: float(v) for k, v in aux.items()})
         self.jsonl.write(rec)
         if not quiet:
             imgs = self.cfg.global_batch_size / max(rec["step_s"], 1e-9)
+            phases = ""
+            if "fwd_bwd_s" in rec:
+                phases = f" fb={1e3 * rec['fwd_bwd_s']:.1f}ms"
+                if "select_s" in rec:
+                    phases += f" sel={1e3 * rec['select_s']:.1f}ms"
+                phases += f" comm={1e3 * rec['comm_update_s']:.1f}ms"
             self.logger.info(
                 "step %d (ep %d) loss=%.4f lr=%.4g io=%.1fms step=%.1fms "
-                "(%.0f ex/s) sent=%dB %s", step, self.epoch, loss, lr,
-                1e3 * rec["io_s"], 1e3 * rec["step_s"], imgs,
+                "(%.0f ex/s)%s sent=%dB %s", step, self.epoch, loss, lr,
+                1e3 * rec["io_s"], 1e3 * rec["step_s"], imgs, phases,
                 rec["bytes_sent"],
                 " ".join(f"{k}={float(v):.4f}" for k, v in aux.items()))
         self.timers.reset()
@@ -366,6 +408,11 @@ class Trainer:
             out["top1"] = totals["top1"] / n
         if "top5" in totals:
             out["top5"] = totals["top5"] / n
+        if "cer_edit_sum" in totals:
+            # character error rate from the greedy CTC decode (VERDICT r3
+            # item 5): total edit distance / total reference characters
+            out["cer"] = (totals["cer_edit_sum"]
+                          / max(totals.get("cer_ref_sum", 1.0), 1.0))
         if self.spec.task == "lm":
             out["perplexity"] = math.exp(min(out["val_loss"], 30.0))
         rec = {"event": "eval", "step": self.step,

@@ -154,3 +154,66 @@ def test_an4_features_drive_ctc_model(tmp_path):
                       (jnp.asarray(x), jnp.asarray(y)),
                       jax.random.PRNGKey(1))
     assert np.isfinite(float(loss)) and float(loss) > 0
+
+
+def _levenshtein_oracle(a, b):
+    """Plain-python reference edit distance."""
+    dp = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        prev, dp[0] = dp[0], i
+        for j, cb in enumerate(b, 1):
+            prev, dp[j] = dp[j], min(dp[j] + 1, dp[j - 1] + 1,
+                                     prev + (ca != cb))
+    return dp[len(b)]
+
+
+def test_ctc_greedy_decode_collapses_and_drops_blanks():
+    import jax
+    import jax.numpy as jnp
+    from gaussiank_sgd_tpu.training.losses import ctc_greedy_decode
+
+    # frame argmaxes: [1, 1, 0, 2, 2, 2, 0, 1] -> decoded "1 2 1"
+    frames = [1, 1, 0, 2, 2, 2, 0, 1]
+    logits = jnp.stack([jax.nn.one_hot(f, 4) for f in frames])[None] * 10.0
+    ids, mask = ctc_greedy_decode(logits)
+    decoded = np.asarray(ids)[0][np.asarray(mask)[0]]
+    np.testing.assert_array_equal(decoded, [1, 2, 1])
+
+
+def test_char_error_counts_match_levenshtein_oracle():
+    import jax
+    import jax.numpy as jnp
+    from gaussiank_sgd_tpu.training.losses import (char_error_counts,
+                                                   ctc_greedy_decode)
+
+    rng = np.random.default_rng(0)
+    B, T, U, V = 6, 24, 8, 12
+    logits = jnp.asarray(rng.normal(size=(B, T, V)).astype(np.float32))
+    labels = np.zeros((B, U), np.int32)
+    for b in range(B):
+        n = rng.integers(1, U + 1)
+        labels[b, :n] = rng.integers(1, V, size=n)
+    edit_sum, ref_sum = char_error_counts(logits, jnp.asarray(labels))
+    ids, mask = ctc_greedy_decode(logits)
+    ids, mask = np.asarray(ids), np.asarray(mask)
+    want_edit = want_ref = 0
+    for b in range(B):
+        hyp = ids[b][mask[b]].tolist()
+        ref = labels[b][labels[b] != 0].tolist()
+        want_edit += _levenshtein_oracle(hyp, ref)
+        want_ref += len(ref)
+    assert int(edit_sum) == want_edit
+    assert int(ref_sum) == want_ref
+
+
+def test_perfect_decode_gives_zero_cer():
+    import jax
+    import jax.numpy as jnp
+    from gaussiank_sgd_tpu.training.losses import char_error_counts
+
+    # logits that decode exactly to the labels (with blanks between)
+    labels = jnp.asarray([[3, 4, 3, 0]], jnp.int32)
+    frames = [3, 0, 4, 0, 3, 0]
+    logits = jnp.stack([jax.nn.one_hot(f, 6) for f in frames])[None] * 10.0
+    edit_sum, ref_sum = char_error_counts(logits, labels)
+    assert int(edit_sum) == 0 and int(ref_sum) == 3

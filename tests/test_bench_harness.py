@@ -54,3 +54,57 @@ def test_bench_json_contract(monkeypatch, capsys):
     assert "winner_secondary" in cfgs["resnet20"]
     assert result["detail"]["worst_config_ratio_median"] == min(
         c["ratio_median"] for c in cfgs.values())
+
+
+def test_bench_config5_matches_exp_config_operating_point():
+    """bench.py and exp_configs/config5*.json must share one operating
+    point (VERDICT r3 item 8): per-chip batch is the biggest MFU lever,
+    so two different 'config 5's would make the numbers incomparable."""
+    import glob
+    import json
+    import os
+
+    import bench
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg5 = glob.glob(os.path.join(repo, "exp_configs", "config5*.json"))
+    assert cfg5, "config5 exp config missing"
+    batch = {json.load(open(p))["batch_size"] for p in cfg5}
+    assert len(batch) == 1
+    bench_row = [c for c in bench.CONFIGS if c[0] == "transformer_wmt"][0]
+    assert bench_row[3] == batch.pop()
+
+
+def test_bench_fixed_selector_is_the_registry_policy():
+    """The headline selector IS the codified ex-ante default — not a
+    bench-local constant that can drift from what users inherit
+    (VERDICT r3 item 2)."""
+    import bench
+    from gaussiank_sgd_tpu.compressors import (DEFAULT_SELECTOR,
+                                               default_selector,
+                                               get_compressor)
+
+    assert bench.FIXED == DEFAULT_SELECTOR
+    assert default_selector() == DEFAULT_SELECTOR
+    assert default_selector("resnet50") in bench.SWEEP or \
+        default_selector("resnet50") == DEFAULT_SELECTOR
+    # 'auto' resolves through the same policy
+    assert get_compressor("auto").name == \
+        get_compressor(DEFAULT_SELECTOR).name
+
+
+def test_microbatch_divisibility_asserts():
+    """--nsteps-update must divide the per-worker batch (VERDICT r3
+    item 8): a clear ValueError, not a reshape error deep in jit."""
+    import jax.numpy as jnp
+    import pytest
+
+    from gaussiank_sgd_tpu.parallel.trainstep import _microbatch_grads
+
+    def loss_fn(params, mstate, batch, rng):
+        return jnp.sum(params["w"] * batch[0].sum()), (mstate, {})
+
+    with pytest.raises(ValueError, match="not divisible"):
+        _microbatch_grads(loss_fn, {"w": jnp.ones(())}, {},
+                          (jnp.ones((10, 2)), jnp.ones((10,))),
+                          None, num_microbatches=3)

@@ -84,8 +84,10 @@ def test_batched_warm_matches_per_chunk():
 
 
 def test_batched_warm_cold_start():
-    """Zero state -> scalar cond takes the cold branch for every lane:
-    selection == stateless gaussian per chunk, and states become usable."""
+    """Zero state -> scalar cond takes the cold branch for every lane: the
+    threshold (and so the selection mask) equals the stateless gaussian's,
+    packed with the batched path's magnitude priority (ADVICE r3 rework:
+    cold recovery shares the warm pack), and states become usable."""
     from gaussiank_sgd_tpu.compressors.gaussian import (
         gaussian_warm_compress_batched)
     n_chunks, chunk, k = 3, 4096, 64
@@ -95,8 +97,23 @@ def test_batched_warm_cold_start():
     ref = get_compressor("gaussian", density=k / chunk)
     for i in range(n_chunks):
         ri = ref.fn(x[i], k)
-        np.testing.assert_array_equal(np.asarray(rb.compressed.indices[i]),
-                                      np.asarray(ri.compressed.indices))
+        # identical bisected threshold => identical above-threshold count
+        assert int(rb.num_selected[i]) == int(ri.num_selected)
+        bi = np.asarray(rb.compressed.indices[i])
+        bv = np.asarray(rb.compressed.values[i])
+        sel = set(bi[bv != 0].tolist())
+        count = int(ri.num_selected)
+        refset = set(np.asarray(ri.compressed.indices)[
+            np.asarray(ri.compressed.values) != 0].tolist())
+        if count <= k:
+            # no truncation: both pack the full mask -> same set
+            assert sel == refset
+        else:
+            # magnitude truncation keeps the k largest of the mask
+            assert len(sel) == k
+            mags = np.abs(np.asarray(x[i]))
+            assert min(mags[j] for j in sel) >= max(
+                mags[j] for j in refset - sel)
     assert np.all(np.asarray(tb) > 0)
     # one warm follow-up keeps the EF invariant
     r2, _ = gaussian_warm_compress_batched(x * 1.01, k, tb,

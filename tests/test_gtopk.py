@@ -48,7 +48,7 @@ def test_gtopk_matches_oracle_global_topk():
     def worker(acc_shard):
         acc = acc_shard[0]
         r = topk(acc, k)
-        g = gtopk_allreduce(r.compressed, 8, "dp")
+        g, _bytes = gtopk_allreduce(r.compressed, 8, "dp")
         return g.indices[None], g.values[None]
 
     f = jax.jit(shard_map(worker, mesh=mesh, in_specs=P("dp"),

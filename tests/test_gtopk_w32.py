@@ -34,7 +34,7 @@ topk = get_compressor("topk").fn
 
 def worker(acc_shard):
     r = topk(acc_shard[0], k)
-    g = gtopk_allreduce(r.compressed, PW, "dp")
+    g, _bytes = gtopk_allreduce(r.compressed, PW, "dp")
     return g.indices[None], g.values[None]
 
 f = jax.jit(shard_map(worker, mesh=mesh, in_specs=P("dp"),

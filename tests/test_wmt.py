@@ -90,3 +90,22 @@ def test_mismatched_corpus_sides_fail(tmp_path):
     (tmp_path / "train.de").write_text("x y\n")
     with pytest.raises(ValueError, match="differ"):
         load_wmt_corpus(str(tmp_path), "train", 8, 8, 64)
+
+
+def test_corpus_bleu_properties():
+    """BLEU scorer used by analysis/seq2seq_parity.py (config-5 quality
+    metric, VERDICT r3 item 4): exact match -> 1.0, monotone damage."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    from analysis.seq2seq_parity import corpus_bleu
+
+    refs = [[1, 2, 3, 4, 5, 6, 7, 8], [4, 3, 2, 1, 9, 8, 7, 6]]
+    assert corpus_bleu(refs, refs) == 1.0
+    one_off = [r[:-1] + [10] for r in refs]
+    partial = corpus_bleu(one_off, refs)
+    assert 0.0 < partial < 1.0
+    garbage = [[10, 11, 12, 13, 10, 11, 12, 13] for _ in refs]
+    assert corpus_bleu(garbage, refs) == 0.0
+    # brevity penalty: a short but precise hypothesis scores below 1
+    short = [r[:5] for r in refs]
+    assert 0.0 < corpus_bleu(short, refs) < 1.0
