@@ -53,13 +53,6 @@ COMPRESSORS = ("approxtopk", "gaussian", "gaussian_warm", "approxtopk16",
 PROBE = "ef_only"
 
 
-def _paired_delta_ms(rounds: dict, a: str, b: str):
-    """median over rounds of (a_r - b_r), in ms — drift-robust phase delta."""
-    pairs = [1e3 * (x - y) for x, y in zip(rounds.get(a, []),
-                                           rounds.get(b, []))]
-    return round(statistics.median(pairs), 3) if pairs else None
-
-
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--quick", action="store_true",
@@ -79,7 +72,8 @@ def main(argv=None):
     import jax
 
     from gaussiank_sgd_tpu import virtual_cpu
-    from gaussiank_sgd_tpu.benchlib import bench_model, mfu
+    from gaussiank_sgd_tpu.benchlib import (bench_model, mfu,
+                                            paired_delta_ms)
 
     # persistent compile cache across matrix runs/windows (TPU backend too)
     virtual_cpu.enable_compile_cache("/tmp/gksgd_tpu_cache")
@@ -147,8 +141,8 @@ def main(argv=None):
                     # (sparse_ablation r4 note, code-review r4)
                     "fwd_bwd_ms": (round(1e3 * statistics.median(
                         rnds["dense"]), 3) if rnds.get("dense") else None),
-                    "exchange_ms": _paired_delta_ms(rnds, PROBE, "dense"),
-                    "select_pack_ms": _paired_delta_ms(rnds, c, PROBE),
+                    "exchange_ms": paired_delta_ms(rnds, PROBE, "dense"),
+                    "select_pack_ms": paired_delta_ms(rnds, c, PROBE),
                 })
             print(json.dumps(row["cells"][-len(comps):]), flush=True)
         results.append(row)

@@ -1,28 +1,10 @@
 #!/bin/bash
-# Round-4 convergence-evidence queue (VERDICT r3 items 3/4/5), CPU mesh.
-# Sequential: the host has ONE core (axon-tunnel-measurement memory).
+# Round-4 convergence-evidence queue (VERDICT r3 item 3), CPU mesh.
+# Items 4/5 (seq2seq BLEU, AN4 CER) live in run_r4_quality_arms.sh with
+# the committed protocols; the first-window versions that used to live
+# here (seq2seq peak lr 0.4, an4 time=200) were superseded — see
+# BASELINE.md "Config-5 contract" note — and are gone so a rerun cannot
+# overwrite good artifacts with the known-bad protocol.
 set -x
-cd /root/repo
-# --- item 3: LM parity arms to the dense plateau (5x the r3 steps) ---
-python analysis/convergence_parity.py --arms none,gaussian,gaussian_warm \
-  --batch-size 2 --clip-norm 0.25 --compress-warmup-steps 20 \
-  --dataset ptb --dataset-kwargs '{"vocab_size": 16, "synthetic_order": 1, "bptt": 8, "synthetic_tokens_n": 32768}' \
-  --density 0.01 --devices 8 --dnn lstm --lr 1.0 \
-  --model-kwargs '{"embed_dim": 48, "hidden_dim": 48}' \
-  --outdir /tmp/gksgd_parity_lstm_long --seeds 2 --steps 3000 --tag lstm_ppl_long
-python analysis/convergence_parity.py --arms none,gaussian,randomk \
-  --batch-size 2 --compress-warmup-steps 20 \
-  --dataset ptb --dataset-kwargs '{"vocab_size": 16, "bptt": 16, "synthetic_tokens_n": 32768}' \
-  --density 0.01 --devices 8 --dnn transformer_lm --lr 0.05 \
-  --model-kwargs '{"dim": 32, "heads": 2, "num_layers": 2, "ffn": 64, "max_len": 16, "seq_len": 16, "dropout": 0.0}' \
-  --outdir /tmp/gksgd_parity_tf_long --seeds 2 --steps 2400 --tag transformer_long
-# --- item 4: config-5 seq2seq parity + BLEU on the real model ---
-python analysis/seq2seq_parity.py --steps 800 --seeds 2 --density 0.01 \
-  --outdir /tmp/gksgd_parity_s2s
-# --- item 5: AN4 CTC parity with CER ---
-python analysis/convergence_parity.py --dnn lstman4 --dataset an4 \
-  --arms none,gaussian --steps 300 --batch-size 2 --lr 0.02 \
-  --density 0.01 --devices 8 --seeds 2 \
-  --model-kwargs '{"hidden": 32, "num_layers": 1}' \
-  --dataset-kwargs '{"tgt_len": 4, "synthetic_examples": 512}' \
-  --compress-warmup-steps 20 --tag an4 --outdir /tmp/gksgd_parity_an4
+cd "$(dirname "$0")/.."
+bash analysis/run_lm_long_arms.sh

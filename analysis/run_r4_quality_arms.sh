@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-4 task-quality arms, take 2 (VERDICT r3 items 4/5): tamer lr +
+# longer horizons after the first seq2seq window showed dense-seed
+# instability at peak lr 0.4 and the an4 CTC needed a smaller time dim
+# to start learning inside a CPU-budget arm.
+set -x
+cd /root/repo
+python analysis/seq2seq_parity.py --steps 2000 --seeds 2 --density 0.01 \
+  --lr 0.02 --compress-warmup-steps 100 --outdir /tmp/gksgd_parity_s2s2
+python analysis/convergence_parity.py --dnn lstman4 --dataset an4 \
+  --arms none,gaussian --steps 600 --batch-size 2 --lr 0.05 \
+  --density 0.01 --devices 8 --seeds 2 \
+  --model-kwargs '{"hidden": 32, "num_layers": 1}' \
+  --dataset-kwargs '{"tgt_len": 3, "synthetic_examples": 512, "time": 64}' \
+  --compress-warmup-steps 30 --tag an4 --outdir /tmp/gksgd_parity_an4b

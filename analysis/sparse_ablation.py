@@ -45,7 +45,7 @@ def main(argv=None):
     # bill again
     virtual_cpu.enable_compile_cache("/tmp/gksgd_tpu_cache")
 
-    import statistics
+    from gaussiank_sgd_tpu.benchlib import paired_delta_ms
 
     names = ("ef_only", "sel_nores", "approxtopk16", "gaussian_warm",
              "gaussian_fused")
@@ -56,16 +56,13 @@ def main(argv=None):
     ms = {k: round(1e3 * v, 3) for k, v in times.items()
           if isinstance(v, float) and not k.startswith("_")}
 
-    # PAIRED per-round deltas (r4 fix): min-of-rounds per variant can land
-    # different variants in different drift regimes of the shared chip and
-    # produce physically impossible (negative) decompositions — the first
-    # r4 run did exactly that. Every variant runs inside every round, so
-    # the median over rounds of (a_r - b_r) is drift-robust.
+    # PAIRED per-round deltas — the shared drift-robust estimator
+    # (benchlib.paired_delta_ms; see its docstring for why min-of-rounds
+    # deltas are invalid here)
     rnds = times["_rounds"]
 
     def delta_ms(a, b):
-        per_round = [1e3 * (x - y) for x, y in zip(rnds[a], rnds[b])]
-        return round(statistics.median(per_round), 3)
+        return paired_delta_ms(rnds, a, b)
 
     out = {
         "model": "transformer 57M, b=64, density 0.001",

@@ -67,6 +67,23 @@ def mfu(flops_per_step: Optional[float], step_seconds: float,
     return flops_per_step / (step_seconds * peak)
 
 
+def paired_delta_ms(rounds: dict, a: str, b: str) -> Optional[float]:
+    """Median over rounds of per-round (a_r - b_r), in ms.
+
+    THE drift-robust phase-delta estimator (shared by sparse_ablation.py
+    and bench_matrix.py): min-of-rounds differences between variants can
+    land in different drift regimes of the shared chip and produce
+    physically impossible (negative) decompositions — the first r4
+    ablation run did exactly that. Every variant runs inside every
+    rotated round, so paired medians cancel the drift.
+    """
+    import statistics
+
+    pairs = [1e3 * (x - y) for x, y in zip(rounds.get(a, []),
+                                           rounds.get(b, []))]
+    return round(statistics.median(pairs), 3) if pairs else None
+
+
 def ablation_specs():
     """Probe compressors that run a PREFIX of the sparse pipeline, for
     drift-free phase decomposition (VERDICT r3 item 6; the reference

@@ -58,7 +58,8 @@ def make_imagenet(data_dir: Optional[str] = None, train: bool = True,
 def make_an4(data_dir: Optional[str] = None, train: bool = True,
              batch_size: int = 16, seed: int = 0,
              synthetic_examples: int = 256, tgt_len: Optional[int] = None,
-             widths: Tuple[int, ...] = (100, 200, 400, 800)):
+             widths: Tuple[int, ...] = (100, 200, 400, 800),
+             freq: int = 161, time: int = 200):
     """AN4 speech (SURVEY.md §2 C9).
 
     Real-data path: ``{data_dir}/an4_{train|val}_manifest.csv`` in the
@@ -92,7 +93,10 @@ def make_an4(data_dir: Optional[str] = None, train: bool = True,
                 f"{manifest} not found, but {sorted(other)} exist in "
                 f"{data_dir}; provide the {split} manifest (or use "
                 f"data_dir='synthetic' for the all-synthetic fallback)")
-    x, y = synthetic_spectrograms(synthetic_examples, 161, 200, 29,
+    # ``freq``/``time`` shrink the synthetic spectrograms for toy-size CPU
+    # parity arms (the conv+biLSTM cost is ~linear in ``time``); the real
+    # path ignores them — real wavs dictate their own shapes
+    x, y = synthetic_spectrograms(synthetic_examples, freq, time, 29,
                                   tgt_len or 8, seed=0 if train else 1)
     return ArrayDataset((x, y), batch_size, shuffle=train, seed=seed), 29
 
