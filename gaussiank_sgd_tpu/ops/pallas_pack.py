@@ -75,13 +75,20 @@ _ROW_MASK = (1 << _ROW_BITS) - 1
 
 
 def rows_per_block(density: float) -> int:
-    """Reduction span R by density so lambda = R*density stays ~<= 2.
+    """Reduction span R by density so lambda = R*density stays ~<= 4.
 
     Cap overflow per column is Poisson: P(X > S | lambda). With S=8,
-    R=1024 @ density 0.002 gives lambda ~2.05 (overflow ~1e-4 of columns);
-    R=256 @ density 0.02 gives lambda ~5.1 (overflow ~7%, still EF-safe).
-    Above density 0.05 the candidate buffer stops being small — callers
-    should use the XLA pack instead (see supports_density).
+    R=1024 @ density 0.002 gives lambda ~2.05 (overflow ~2e-4 of
+    columns) and a candidate buffer of n/128; R=256 @ density 0.02 gives
+    lambda ~5.1 (overflow ~7%, still EF-safe: capped entries stay in the
+    residual). Above density 0.05 the candidate buffer stops being small
+    — callers should use the XLA pack instead (see supports_density).
+
+    R=2048 (half the phase-2 top-k work) was tried and measured SLOWER
+    end-to-end on v5e: the [R,128] f32 block + int32 key + intermediates
+    approach the ~16 MB VMEM budget at R=2048, costing the pipeline its
+    double-buffering headroom — the HBM read stops overlapping the
+    extraction loop. R=1024 keeps ~3 MB live per grid step.
     """
     if density <= 0.002:
         return 1024

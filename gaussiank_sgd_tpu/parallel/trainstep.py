@@ -46,10 +46,11 @@ class TrainState(NamedTuple):
     """Training state. Everything is replicated across dp EXCEPT
     ``ef_residual``, which is genuinely per-worker (each worker's un-sent
     gradient mass from *its own* batch shards) and therefore lives as a
-    ``[num_devices, total_numel]`` array sharded over the dp axes — so a
-    checkpoint/restore or reshard preserves every worker's residual, not
-    just worker 0's (SURVEY.md §2.3, §3.5: the reference likely drops EF
-    state from checkpoints; we keep it, correctly sharded).
+    flat ``[num_devices * total_numel]`` array sharded over the dp axes
+    (contiguous per-worker slices) — so a checkpoint/restore or reshard
+    preserves every worker's residual, not just worker 0's (SURVEY.md
+    §2.3, §3.5: the reference likely drops EF state from checkpoints; we
+    keep it, correctly sharded).
     """
 
     step: jax.Array          # int32 scalar (replicated)
@@ -57,7 +58,19 @@ class TrainState(NamedTuple):
     model_state: Any         # non-trainable collections, e.g. BatchNorm
                              # running stats (replicated; dp-meaned each step)
     opt_state: optax.OptState  # (replicated)
-    ef_residual: jax.Array   # float32[num_devices, total_numel], sharded(dp)
+    ef_residual: jax.Array   # float32[num_devices * total_numel], sharded
+                             # over dp on dim 0 — worker p owns the
+                             # contiguous [p*N, (p+1)*N) slice. FLAT on
+                             # purpose: a [P, N] array's per-device [1, N]
+                             # shard gets a degenerate (1,128)-tiled layout
+                             # and XLA inserts full-buffer relayout copies
+                             # converting to/from the flat math view every
+                             # sparse step (measured r4: part of a
+                             # 2.4-4.2 ms EF floor); the 1-D form keeps one
+                             # linear T(1024) layout end to end.
+                             # Checkpoints still store [P, N]
+                             # (training/checkpoint.py reshapes at the
+                             # edges), so the on-disk format is unchanged.
     rng: jax.Array           # PRNG key (replicated)
     carry: Any = ()          # recurrent hidden state carried across steps
                              # (the reference's bptt "repackaging",
@@ -407,7 +420,8 @@ def build_dp_train_step(
         loss, mstate, aux, new_carry, flat_g, unravel = _local_grads(
             state, batch, data_rng)
         scale = fold_lr(state.step) if fold_lr is not None else 1.0
-        acc = state.ef_residual[0] + scale * flat_g  # local residual row
+        # the local ef_residual shard IS this worker's flat [N] row
+        acc = state.ef_residual + scale * flat_g
         comp, residual, nsel, cstate = compress_buckets(
             spec, plan, acc, comp_rng,
             state.comp_state[0] if spec.stateful else ())
@@ -421,24 +435,31 @@ def build_dp_train_step(
             # trace-time count of the buffers actually ppermuted (shape x
             # itemsize per butterfly round) — measured, not a formula
             gcomp, n_bytes = gtopk_allreduce(comp, mesh.size, gather_axis)
-            dense = decompress(gcomp, n_total, grad_dtype) / _all_axes_size()
+            # the /P average rides the k-sized VALUES, not the n-sized
+            # dense buffer: one full read+write pass saved (r4 floor work)
+            gcomp = gcomp._replace(values=gcomp.values / _all_axes_size())
+            dense = decompress(gcomp, n_total, grad_dtype)
             residual = global_residual(acc, gcomp)
             bytes_sent = jnp.float32(n_bytes)
         else:
             # ONE all-gather of the packed pairs over the (ICI) gather axis,
             # scatter-summed dense; hierarchical meshes psum the dense
-            # partial across the outer (DCN) axes (collectives.py).
+            # partial across the outer (DCN) axes (collectives.py). The /P
+            # average is applied to the k-sized gathered values BEFORE the
+            # scatter — dividing the n-sized dense buffer costs a full
+            # read+write pass; each outer-axis partial is already /P-scaled
+            # so the psum-summed result is identical.
             g_idx = lax.all_gather(comp.indices, gather_axis, tiled=True)
-            g_val = lax.all_gather(comp.values, gather_axis, tiled=True)
+            g_val = lax.all_gather(comp.values, gather_axis,
+                                   tiled=True) / _all_axes_size()
             dense = decompress(CompressedGrad(g_idx, g_val), n_total,
                                grad_dtype)
             for a in outer_axes:
                 dense = lax.psum(dense, a)
-            dense = dense / _all_axes_size()
             bytes_sent = jnp.float32(
                 k_packed * (4 + comp.values.dtype.itemsize))
 
-        new_state = _apply(state, mstate, dense, unravel, residual[None, :],
+        new_state = _apply(state, mstate, dense, unravel, residual,
                            new_carry,
                            cstate[None, :] if spec.stateful else ())
         return new_state, StepMetrics(
@@ -468,7 +489,8 @@ def build_dp_train_step(
         # dim 0 (examples) over the dp axes, dim 1 (sequence) over sp
         batch_spec = P(axes[:-1] or None, axes[-1])
     # Pytree-prefix specs: everything in TrainState is replicated except the
-    # per-worker ef_residual (leading [num_devices] dim) and the recurrent
+    # per-worker ef_residual (flat, contiguous per-worker slices on dim 0)
+    # and the recurrent
     # carry (batch-dim sharded, like the batch itself).
     state_spec = TrainState(step=P(), params=P(), model_state=P(),
                             opt_state=P(), ef_residual=P(axes), rng=P(),
@@ -507,7 +529,7 @@ def build_dp_train_step(
             loss, mstate, aux, new_carry, flat_g, unravel = _local_grads(
                 state, batch, data_rng)
             scale = fold_lr(state.step) if fold_lr is not None else 1.0
-            acc = state.ef_residual[0] + scale * flat_g
+            acc = state.ef_residual + scale * flat_g
             comp, residual, nsel, _cstate = compress_buckets(
                 spec, plan, acc, comp_rng,
                 state.comp_state[0] if spec.stateful else ())
@@ -560,7 +582,7 @@ def build_dp_train_step(
             params=params,
             model_state=model_state,
             opt_state=optimizer.init(params),
-            ef_residual=jnp.zeros((mesh.size, n_total), grad_dtype),
+            ef_residual=jnp.zeros((mesh.size * n_total,), grad_dtype),
             rng=rng,
             carry=jax.tree.map(jnp.copy, carry),
             comp_state=(jnp.full((mesh.size, len(plan.buckets)),

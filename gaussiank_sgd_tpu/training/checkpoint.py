@@ -22,8 +22,30 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..parallel.trainstep import TrainState
 
 
-def save_checkpoint(ckpt_dir: str, state: TrainState) -> str:
+def _dp_width(state: TrainState) -> Optional[int]:
+    """dp width of a live state from the flat ef_residual's mesh
+    sharding; None when the array carries no mesh (meshless state)."""
+    sh = getattr(state.ef_residual, "sharding", None)
+    mesh = getattr(sh, "mesh", None)
+    if mesh is not None and getattr(mesh, "size", 0):
+        return int(mesh.size)
+    return None
+
+
+def save_checkpoint(ckpt_dir: str, state: TrainState,
+                    num_workers: Optional[int] = None) -> str:
     """Write a checkpoint for the current step; returns its path.
+
+    The live ``ef_residual`` is flat ``[P*N]`` (layout, see TrainState
+    docstring); on disk it stays ``[P, N]`` so the format is unchanged
+    across rounds and the worker count is recoverable from the array
+    shape alone (elastic restore reads it from metadata). ``P`` comes
+    from the array's mesh sharding; a meshless state must pass
+    ``num_workers`` explicitly — guessing (e.g. 1) would write a
+    corrupted ``[1, P*N]`` shape that poisons every later elastic
+    restore. The reshape is a jitted shard-local view (dim-0 contiguous
+    blocks stay put), so orbax still saves a sharded array — no host
+    gather (which would also break non-fully-addressable DCN meshes).
 
     Idempotent per step: a checkpoint that already exists for this step is
     left in place (covers epoch-boundary + final-save landing on the same
@@ -33,8 +55,26 @@ def save_checkpoint(ckpt_dir: str, state: TrainState) -> str:
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
     if os.path.exists(path):
         return path
+    p = num_workers or _dp_width(state)
+    if not p:
+        raise ValueError(
+            "save_checkpoint: the state's ef_residual carries no mesh "
+            "sharding; pass num_workers= so the on-disk [P, N] shape is "
+            "written correctly")
+    if state.ef_residual.size % p:
+        raise ValueError(
+            f"ef_residual size {state.ef_residual.size} is not divisible "
+            f"by num_workers={p}")
+    sh = getattr(state.ef_residual, "sharding", None)
+    mesh = getattr(sh, "mesh", None)
+    if mesh is not None and getattr(mesh, "size", 0):
+        dp2d = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+        ef = jax.jit(lambda x: x.reshape(p, -1),
+                     out_shardings=dp2d)(state.ef_residual)
+    else:
+        ef = state.ef_residual.reshape(p, -1)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, state)
+    ckptr.save(path, state._replace(ef_residual=ef))
     ckptr.wait_until_finished()
     return path
 
@@ -76,11 +116,18 @@ def restore_checkpoint(path: str, target: TrainState,
             x.shape, x.dtype, sharding=sharding or x.sharding)
 
     # detect a worker-count mismatch from the checkpoint's own metadata
+    # (on disk ef_residual is [P, N]; live it is flat [P*N])
     meta = ckptr.metadata(path).item_metadata
     old_p = int(meta["ef_residual"].shape[0])
-    new_p = int(target.ef_residual.shape[0])
     ef_dtype = target.ef_residual.dtype
-    n_flat = int(target.ef_residual.shape[1])
+    n_flat = int(meta["ef_residual"].shape[1])
+    new_p = int(target.ef_residual.size) // n_flat
+    if new_p * n_flat != target.ef_residual.size or new_p < 1:
+        # user-facing artifact validation: a bare assert would vanish
+        # under -O and silently mis-redistribute mass (code-review r4)
+        raise ValueError(
+            f"checkpoint param count {n_flat} does not divide the live "
+            f"ef_residual ({target.ef_residual.size}) — different model?")
     carry_leaves = jax.tree_util.tree_leaves(target.carry)
 
     def _old_shape_carry(sharding=None):
@@ -98,8 +145,11 @@ def restore_checkpoint(path: str, target: TrainState,
         repl = NamedSharding(mesh, P())
         dp = NamedSharding(mesh, P(tuple(mesh.axis_names)))
         # on a mismatch the old rows restore REPLICATED (old_p need not tile
-        # the new mesh) and redistribute below
-        ef_abstract = (sds(target.ef_residual, dp) if old_p == new_p else
+        # the new mesh) and redistribute below; on a match the [P, N] disk
+        # array restores dp-sharded on dim 0 and flattens after
+        ef_abstract = (jax.ShapeDtypeStruct((old_p, n_flat), ef_dtype,
+                                            sharding=dp)
+                       if old_p == new_p else
                        jax.ShapeDtypeStruct((old_p, n_flat), ef_dtype,
                                             sharding=repl))
         carry_abstract = (jax.tree.map(lambda x: sds(x, dp), target.carry)
@@ -122,9 +172,10 @@ def restore_checkpoint(path: str, target: TrainState,
         )
     else:
         abstract = jax.tree.map(sds, target)
+        abstract = abstract._replace(
+            ef_residual=jax.ShapeDtypeStruct((old_p, n_flat), ef_dtype))
         if old_p != new_p:
             abstract = abstract._replace(
-                ef_residual=jax.ShapeDtypeStruct((old_p, n_flat), ef_dtype),
                 carry=_old_shape_carry(),
                 comp_state=jax.tree.map(
                     lambda x: jax.ShapeDtypeStruct(
@@ -133,10 +184,22 @@ def restore_checkpoint(path: str, target: TrainState,
     restored = ckptr.restore(path, abstract)
     if not isinstance(restored, TrainState):
         restored = TrainState(*restored)
+    if old_p == new_p:
+        # [P, N] disk layout -> live flat [P*N]; with a mesh the reshape
+        # is shard-local (dim-0 contiguous blocks stay put)
+        if mesh is not None:
+            dp_flat = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+            ef = jax.jit(lambda x: x.reshape(-1),
+                         out_shardings=dp_flat)(restored.ef_residual)
+        else:
+            ef = restored.ef_residual.reshape(-1)
+        restored = restored._replace(ef_residual=ef)
     if old_p != new_p:
-        # mass-preserving redistribution: every new row = total/new_p
+        # mass-preserving redistribution: every new row = total/new_p,
+        # flattened to the live [new_p * N] layout
         total = jnp.sum(restored.ef_residual, axis=0)
-        ef = jnp.tile((total / new_p)[None, :], (new_p, 1)).astype(ef_dtype)
+        ef = jnp.tile((total / new_p)[None, :],
+                      (new_p, 1)).astype(ef_dtype).reshape(-1)
         # the recurrent carry restarts from zeros: its rows are batch rows
         # of the OLD worker geometry and cannot be remapped; warm-up costs
         # a few windows, convergence state (params/opt/EF) is preserved

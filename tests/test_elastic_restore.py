@@ -51,16 +51,17 @@ def _problem(n_dev, batch=16):
 def test_restore_onto_smaller_mesh(tmp_path, new_p):
     ts8, s8, b8 = _problem(8)
     s8, _ = ts8.sparse_step(s8, b8)          # make EF residual non-zero
-    ef_total = np.asarray(s8.ef_residual).sum(axis=0)
+    ef_total = np.asarray(s8.ef_residual).reshape(8, -1).sum(axis=0)
     assert np.abs(ef_total).sum() > 0
     path = save_checkpoint(str(tmp_path / "ck"), s8)
 
     ts_n, s_n, b_n = _problem(new_p)
     restored = restore_checkpoint(path, s_n, ts_n.mesh)
-    assert restored.ef_residual.shape[0] == new_p
+    assert restored.ef_residual.size == new_p * (ef_total.size)
     # mass preservation: rows sum to the old total
     np.testing.assert_allclose(
-        np.asarray(restored.ef_residual).sum(axis=0), ef_total,
+        np.asarray(restored.ef_residual).reshape(new_p, -1).sum(axis=0),
+        ef_total,
         rtol=1e-5, atol=1e-7)
     # params restore exactly and the state steps on the new mesh
     for a, b in zip(jax.tree_util.tree_leaves(s8.params),
@@ -133,7 +134,7 @@ def test_trainer_resume_with_different_worker_count(tmp_path):
     t4 = Trainer(TrainConfig(**base, nworkers=4, run_id="resumed4",
                              resume=os.path.dirname(ckpt)))
     assert t4.step == 6
-    assert t4.state.ef_residual.shape[0] == 4
+    assert t4.state.ef_residual.size % 4 == 0 and t4.state.ef_residual.ndim == 1
     t4.train(3)
     assert t4.step == 9
     t4.close()

@@ -81,7 +81,8 @@ def test_spmd_step_matches_numpy_oracle():
                                    rtol=2e-5, atol=2e-6,
                                    err_msg=f"step {s}")
     # the per-worker EF residual trajectories match too
-    np.testing.assert_allclose(np.asarray(state.ef_residual), res_ref,
+    np.testing.assert_allclose(
+        np.asarray(state.ef_residual).reshape(res_ref.shape), res_ref,
                                rtol=2e-5, atol=2e-6)
     # and the metrics report the exact sparse payload
     assert int(m.bytes_sent) == k * 8

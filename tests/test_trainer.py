@@ -90,7 +90,7 @@ def test_checkpoint_save_restore_roundtrip(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     np.testing.assert_array_equal(np.asarray(t.state.ef_residual),
                                   np.asarray(restored.ef_residual))
-    assert restored.ef_residual.shape[0] == 8  # per-worker rows preserved
+    assert restored.ef_residual.ndim == 1  # live layout is flat [P*N]
     # restored state must come back with live shardings: stepping it must
     # work (catches restores committed to a single device)
     t2.state = restored
