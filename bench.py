@@ -103,18 +103,25 @@ def main():
               f"min {cell['ratio_min']} mfu_dense {cell['mfu_dense']}",
               flush=True)
 
-    value = headline["ratio_median"]
-    worst = min(detail_configs.values(), key=lambda c: c["ratio_median"])
+    # The contract is "EVERY config >= 0.90" (BASELINE.json metric), so the
+    # reportable scalar is the MIN over config medians — the binding number
+    # (VERDICT r4 item 2). The flagship resnet20 cell stays in detail.
+    worst_key, worst = min(detail_configs.items(),
+                           key=lambda kv: kv[1]["ratio_median"])
+    value = worst["ratio_median"]
     result = {
         "metric": "sparse_vs_dense_step_throughput_ratio",
         "value": value,
         "unit": "ratio",
         "vs_baseline": round(value / 0.90, 4),
         "detail": {
-            "headline": f"median-of-rounds ratio, ex-ante default selector "
-                        f"{FIXED} (registry.DEFAULT_SELECTOR policy), "
-                        f"resnet20/b1024, density {density}",
+            "headline": f"WORST-config median-of-rounds ratio ({worst_key}) "
+                        f"over all 5 BASELINE configs, ex-ante default "
+                        f"selector {FIXED} (registry.DEFAULT_SELECTOR "
+                        f"policy), density {density}",
+            "worst_config": worst_key,
             "worst_config_ratio_median": worst["ratio_median"],
+            "flagship_ratio_median": headline["ratio_median"],
             "configs": detail_configs,
             "methodology": "N-step fori_loop per dispatch, scalar fence, "
                            "interleaved rotated rounds; ratios paired "
@@ -136,7 +143,9 @@ def main():
         "metric": result["metric"], "value": value, "unit": "ratio",
         "vs_baseline": result["vs_baseline"],
         "detail": {
-            "policy": f"fixed ex-ante default selector {FIXED}",
+            "policy": f"fixed ex-ante default selector {FIXED}; value = "
+                      f"worst-config median ({worst_key})",
+            "worst_config": worst_key,
             "worst_config_ratio_median": worst["ratio_median"],
             "config_medians": {k: c["ratio_median"]
                                for k, c in detail_configs.items()},

@@ -76,12 +76,19 @@ def paired_delta_ms(rounds: dict, a: str, b: str) -> Optional[float]:
     physically impossible (negative) decompositions — the first r4
     ablation run did exactly that. Every variant runs inside every
     rotated round, so paired medians cancel the drift.
+
+    Returns None (instead of silently zip-truncating) when the two
+    variants have different round counts — a partial/crashed run re-read
+    from artifacts would otherwise misalign the pairing and corrupt the
+    drift-cancelling property (ADVICE r4).
     """
     import statistics
 
-    pairs = [1e3 * (x - y) for x, y in zip(rounds.get(a, []),
-                                           rounds.get(b, []))]
-    return round(statistics.median(pairs), 3) if pairs else None
+    ra, rb = rounds.get(a, []), rounds.get(b, [])
+    if not ra or len(ra) != len(rb):
+        return None
+    pairs = [1e3 * (x - y) for x, y in zip(ra, rb)]
+    return round(statistics.median(pairs), 3)
 
 
 def ablation_specs():

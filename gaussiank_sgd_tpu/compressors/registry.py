@@ -103,7 +103,8 @@ def get_compressor(name: str, *, density: float = 0.001,
         bfn = functools.partial(gaussian_warm_compress_batched,
                                 density=density, sigma_scale=sigma_scale)
         if not supports_density(density):
-            # candidate geometry stops paying above ~5% density; the warm
+            # the kernel's candidate buffer can't hold k above density
+            # S/R = 0.03125 (pallas_pack.supports_density); the warm
             # XLA pack is the right tool there. The spec NAME says so —
             # a benchmark labeling this cell 'gaussian_fused' would
             # otherwise time the identical program under two labels

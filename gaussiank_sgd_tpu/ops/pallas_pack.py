@@ -81,8 +81,12 @@ def rows_per_block(density: float) -> int:
     R=1024 @ density 0.002 gives lambda ~2.05 (overflow ~2e-4 of
     columns) and a candidate buffer of n/128; R=256 @ density 0.02 gives
     lambda ~5.1 (overflow ~7%, still EF-safe: capped entries stay in the
-    residual). Above density 0.05 the candidate buffer stops being small
-    — callers should use the XLA pack instead (see supports_density).
+    residual). The hard ceiling is candidate CAPACITY, not overflow: the
+    buffer holds S/R of n slots, so k = ceil(density*n) fits only while
+    density <= S/R = 0.03125 for R=256 (ADVICE r4: the old 0.05 bound let
+    densities in (0.03125, 0.05] route every call to the XLA warm path
+    while keeping the 'gaussian_fused' name). supports_density is the
+    single source of truth for that bound.
 
     R=2048 (half the phase-2 top-k work) was tried and measured SLOWER
     end-to-end on v5e: the [R,128] f32 block + int32 key + intermediates
@@ -92,14 +96,20 @@ def rows_per_block(density: float) -> int:
     """
     if density <= 0.002:
         return 1024
-    if density <= 0.05:
+    if supports_density(density):
         return 256
     raise ValueError(
-        f"fused select+pack supports density <= 0.05, got {density}")
+        f"fused select+pack supports density <= {_S / 256}, got {density}")
 
 
 def supports_density(density: float) -> bool:
-    return density <= 0.05
+    """True iff the kernel geometry can emit k = density*n pairs.
+
+    The R=256 geometry's candidate buffer has S/R = 8/256 = 0.03125 of n
+    slots — the capacity ceiling. Beyond it ``gaussian_fused_compress``
+    would route every call to the XLA warm path, so the registry must
+    rename the spec instead (one label, one program)."""
+    return density <= _S / 256
 
 
 def _select_kernel(x_ref, t_ref, val_ref, idx_ref, count_ref, *, rows: int):
@@ -218,7 +228,8 @@ def fused_select_pack(acc: jax.Array, k: int, threshold: jax.Array,
     vals, idxs, count = fused_select_candidates(acc, threshold, density,
                                                 interpret)
     nc = vals.shape[0]
-    if k > nc:  # geometry guarantees nc >= ~1.5k at supported densities;
+    if k > nc:  # geometry guarantees nc >= k at supported densities (with
+        # margin below the density = S/R capacity ceiling, where nc == k);
         # unreachable for k = ceil(density*n), but fail loud for direct calls
         raise ValueError(f"k={k} exceeds candidate capacity {nc} "
                          f"(n={n}, density={density})")
@@ -256,6 +267,12 @@ def gaussian_fused_compress(acc: jax.Array, k: int, state: jax.Array,
                                         gaussian_warm_compress)
 
     n = acc.shape[0]
+    if not supports_density(density):
+        # direct call above the geometry's capacity ceiling (the registry
+        # renames the spec instead of reaching here): route to the XLA warm
+        # path rather than raising from rows_per_block
+        return gaussian_warm_compress(acc, k, state, rng, density=density,
+                                      sigma_scale=sigma_scale, gain=gain)
     R = rows_per_block(density)
     nc = _S * (-(-n // (R * _LANES))) * _LANES
     if k > nc:

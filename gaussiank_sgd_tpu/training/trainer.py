@@ -50,6 +50,13 @@ def _dtype_of(name: str):
             "float32": jnp.float32, "fp32": jnp.float32}[name]
 
 
+def _batch_shape_key(batch):
+    """Hashable (shape, dtype) signature of a batch tree — the retrace key
+    jit uses, so 'first dispatch at this key' == 'this dispatch compiles'."""
+    return tuple((tuple(a.shape), str(a.dtype))
+                 for a in jax.tree.leaves(batch))
+
+
 class Trainer:
     def __init__(self, cfg: TrainConfig):
         self.cfg = cfg
@@ -58,6 +65,11 @@ class Trainer:
         self.logger = make_logger(log_file=os.path.join(run_dir, "train.log"))
         self.jsonl = JSONLWriter(os.path.join(run_dir, "metrics.jsonl"))
         self.timers = PhaseTimers()
+        # phase-breakdown compile hygiene (ADVICE r4): programs whose first
+        # dispatch (= jit compile) already happened, and whether the current
+        # log interval contains such a first dispatch
+        self._dispatched_fns: set = set()
+        self._interval_has_compile = False
 
         # ---- mesh (SURVEY.md §3.1: hvd.init + device binding -> mesh) ----
         self.sp = cfg.sp_size if cfg.sp_size > 1 else 0
@@ -276,6 +288,18 @@ class Trainer:
                     jnp.zeros_like, self.state.carry))
             fn = (self.ts.dense_step if self._in_warmup(step)
                   else self.ts.sparse_step)
+            if cfg.phase_timing:
+                # this interval's step_s mean will include this program's
+                # jit compile; mark it so _phase_breakdown skips the
+                # interval (ADVICE r4: subtracting compile-free probe times
+                # from a compile-polluted mean attributed the whole compile
+                # to comm_update_s). Keyed on (fn, batch shapes): bucketed
+                # variable-width pipelines (AN4) retrace on each new width,
+                # not only on the first dispatch.
+                key = (fn, _batch_shape_key(batch))
+                if key not in self._dispatched_fns:
+                    self._dispatched_fns.add(key)
+                    self._interval_has_compile = True
             self.state, m = fn(self.state, batch)
             # jit dispatch is async: sync before stopping the timer so
             # step_s/ex-s measure device work, not dispatch latency
@@ -312,7 +336,9 @@ class Trainer:
             skip = 0
             ep += 1
 
-    def _phase_breakdown(self, step_s: float) -> Dict[str, float]:
+    def _phase_breakdown(self, step_s: float) -> Dict[str, object]:
+        # values are float seconds, except the string-valued
+        # 'phase_skipped' marker on compile-polluted intervals
         """fwd/bwd, select+pack, and comm+update ms for the CURRENT state —
         the reference's per-interval io/fwd/bwd/comm log breakdown
         (SURVEY.md §5 Tracing row, VERDICT r3 item 6). Times two jitted
@@ -322,13 +348,27 @@ class Trainer:
         analysis/bench_matrix.py's paired-round probe columns."""
         if getattr(self, "_probe_batch", None) is None:
             return {}          # nothing trained yet this process
+        if self._interval_has_compile:
+            # the interval-mean step_s includes the main step's jit compile
+            # while the probes' compiles are excluded below — subtracting
+            # would book the whole compile as comm_update_s (observed:
+            # comm=7202ms on a 112ms step). Skip this interval; the next
+            # one is compile-free (ADVICE r4). The flag is cleared when the
+            # timer interval closes (_log_train -> timers.reset()), so a
+            # quiet final log can't leak it into the next clean interval.
+            return {"phase_skipped": "compile_in_interval"}
         if not hasattr(self, "_probes"):
             self._probes = self.ts.make_probes()
+            self._probe_shapes = set()
+        skey = _batch_shape_key(self._probe_batch)
+        if skey not in self._probe_shapes:
             # compile OUTSIDE the timed windows: the first timed call would
             # otherwise report jit compilation (seconds-to-minutes at 57M)
-            # as fb=/sel= phase time (code-review r4)
+            # as fb=/sel= phase time (code-review r4). Per batch-shape key:
+            # bucketed pipelines retrace the probes on each new width too.
             for fn in self._probes.values():
                 jax.block_until_ready(fn(self.state, self._probe_batch))
+            self._probe_shapes.add(skey)
         t0 = time.perf_counter()
         jax.block_until_ready(self._probes["grads"](self.state,
                                                     self._probe_batch))
@@ -378,6 +418,7 @@ class Trainer:
                 rec["bytes_sent"],
                 " ".join(f"{k}={float(v):.4f}" for k, v in aux.items()))
         self.timers.reset()
+        self._interval_has_compile = False
         return rec
 
     # ------------------------------------------------------------------

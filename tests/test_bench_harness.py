@@ -13,10 +13,16 @@ def _fake_bench_model(model, dataset, batch, density, compressors, n_steps,
                       rounds, **kw):
     base = {"resnet20": 0.020, "vgg16": 0.012, "resnet50": 0.050,
             "lstm": 0.030, "transformer": 0.080}[model]
+    # per-model sparse overhead so the configs have DISTINCT ratios with a
+    # strict worst (transformer) != flagship (resnet20) — otherwise the
+    # worst-config headline assertions would pass vacuously under a
+    # regression to flagship-median reporting
+    over = {"resnet20": 1.02, "vgg16": 1.05, "resnet50": 1.04,
+            "lstm": 1.06, "transformer": 1.10}[model]
     times = {"dense": base}
     rt = {"dense": [base * (1 + 0.02 * r) for r in range(rounds)]}
     for i, c in enumerate(compressors):
-        t = base * (1.05 + 0.01 * i)
+        t = base * (over + 0.01 * i)
         times[c] = t
         rt[c] = [t * (1 + 0.02 * r) for r in range(rounds)]
     times["_rounds"] = rt
@@ -61,11 +67,16 @@ def test_bench_json_contract(monkeypatch, capsys):
         assert cell["ratio_min"] <= cell["ratio_median"] <= cell["ratio_max"]
         assert len(cell["round_ratios"]) >= 3           # dispersion visible
         assert cell["mfu_dense"] is not None
-    # headline = resnet20 median (not the winner's best cell)
-    assert result["value"] == cfgs["resnet20"]["ratio_median"]
+    # headline value = the BINDING number: min over config medians
+    # (VERDICT r4 item 2 — the contract is "every config >= 0.90", so the
+    # reportable scalar is the worst config, not the flagship)
+    assert result["value"] == min(c["ratio_median"] for c in cfgs.values())
+    assert result["value"] == \
+        cfgs[result["detail"]["worst_config"]]["ratio_median"]
+    assert result["detail"]["worst_config_ratio_median"] == result["value"]
+    assert result["detail"]["flagship_ratio_median"] == \
+        cfgs["resnet20"]["ratio_median"]
     assert "winner_secondary" in cfgs["resnet20"]
-    assert result["detail"]["worst_config_ratio_median"] == min(
-        c["ratio_median"] for c in cfgs.values())
 
 
 def test_bench_config5_matches_exp_config_operating_point():
