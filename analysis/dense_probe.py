@@ -1,0 +1,73 @@
+"""Quick dense-step probe for one bench cell: step ms + MFU (+ sparse ratio).
+
+Round-5 dense-baseline work (VERDICT r4 item 1): iterate on the dense
+program (LSTM scan hoisting, transformer step audit) with a fast
+feedback loop, without running the full bench matrix.
+
+Run: python analysis/dense_probe.py lstm_ptb [--sparse] [--rounds 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CELLS = {
+    "resnet20": ("resnet20", "cifar10", 1024, 40),
+    "vgg16": ("vgg16", "cifar10", 256, 20),
+    "resnet50": ("resnet50", "imagenet", 64, 10),
+    "lstm_ptb": ("lstm", "ptb", 160, 10),
+    "transformer_wmt": ("transformer", "wmt", 32, 10),
+}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("cell", choices=sorted(CELLS))
+    p.add_argument("--sparse", action="store_true",
+                   help="also time the default sparse program + ratio")
+    p.add_argument("--rounds", type=int, default=4)
+    p.add_argument("--density", type=float, default=0.001)
+    p.add_argument("--model-kwargs", type=json.loads, default={},
+                   help="JSON model ctor overrides, e.g. dropout/unroll")
+    args = p.parse_args()
+
+    from gaussiank_sgd_tpu import benchlib
+    from gaussiank_sgd_tpu.compressors import DEFAULT_SELECTOR
+
+    model, dataset, batch, n_steps = CELLS[args.cell]
+    comps = [DEFAULT_SELECTOR] if args.sparse else []
+    t = benchlib.bench_model(model, dataset, batch, args.density,
+                             comps or [DEFAULT_SELECTOR], n_steps,
+                             rounds=args.rounds,
+                             model_kwargs=args.model_kwargs or None)
+    dense_rounds = t["_rounds"]["dense"]
+    dense_med = statistics.median(dense_rounds)
+    out = {
+        "cell": args.cell,
+        "dense_ms_median": round(1e3 * dense_med, 3),
+        "dense_ms_min": round(1e3 * min(dense_rounds), 3),
+        "mfu_dense": round(benchlib.mfu(t.get("_dense_step_flops"),
+                                        dense_med,
+                                        t.get("_peak_flops")) or -1, 4),
+        "dense_step_gflops": round((t.get("_dense_step_flops") or 0) / 1e9,
+                                   2),
+    }
+    if args.sparse:
+        sr = t["_rounds"][DEFAULT_SELECTOR]
+        ratios = [d / s for d, s in zip(dense_rounds, sr)]
+        out["sparse_ms_median"] = round(
+            1e3 * statistics.median(sr), 3)
+        out["ratio_median"] = round(statistics.median(ratios), 4)
+        out["ratio_min"] = round(min(ratios), 4)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-5 registry-wide convergence arms (VERDICT r4 item 4): every
+# remaining registry entry gets a seed-paired label-noise arm at the
+# contract density, same protocol as convergence_parity_noise001.json
+# (reproduce string there) so results are comparable across rounds.
+# dgcsampling/redsync/redsynctrim/randomkec/approxtopk16 were the
+# convergence-untested half of the registry.
+set -x
+cd /root/repo
+python analysis/convergence_parity.py \
+  --arms none,dgcsampling,redsync,redsynctrim,randomkec,approxtopk16 \
+  --batch-size 8 --compress-warmup-steps 20 --dataset mnist \
+  --density 0.001 --devices 8 --dnn mnistnet --label-noise 0.25 \
+  --lr 0.01 --outdir /tmp/gksgd_parity_reg --seeds 3 --steps 2000 \
+  --tag registry_noise001 --weight-decay 0.0

@@ -1,0 +1,123 @@
+"""Scale-regime validation: a >=200M-param model on the uniform bucket plan.
+
+VERDICT r4 item 3 / missing #2: nothing had been validated above 57M params,
+and the default selector used to silently lose its Pallas kernel exactly on
+the uniform plans that exist for large-model scaling. This harness runs a
+~234M-param decoder-only transformer (dim 1024, 16 layers, ffn 4096, vocab
+32k, seq 256 — synthetic tokens; the scale is what's under test) through the
+REAL train step with ``bucket_policy='uniform', bucket_size=1<<22`` (the
+VERDICT-r2 scaling recipe) and records:
+
+  * compile + execution of dense and gaussian_fused sparse steps (the sparse
+    step now takes the CHUNKED kernel path, ops/pallas_pack.py
+    ``gaussian_fused_compress_batched`` — asserted, not assumed);
+  * paired-round sparse:dense ratio at the contract density;
+  * bytes-on-wire per step for both (the >500M-payload accounting the
+    f32->i64 bytes_sent retyping exists for);
+  * dense MFU at this scale.
+
+Artifact: analysis/artifacts/scale_bench_200m.json
+
+Run: python analysis/scale_bench.py [--rounds 4] [--batch 8]
+(TPU: the real chip. The same program dryruns on the CPU mesh via
+tests/test_bucketing_scale.py's small-shape twin.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ARTIFACTS = os.path.join(REPO, "analysis", "artifacts")
+
+MODEL_KW = dict(dim=1024, heads=16, num_layers=16, ffn=4096,
+                max_len=256, seq_len=256, dropout=0.1)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rounds", type=int, default=4)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--n-steps", type=int, default=5)
+    p.add_argument("--density", type=float, default=0.001)
+    p.add_argument("--bucket-size", type=int, default=1 << 22)
+    args = p.parse_args()
+
+    import jax
+
+    from gaussiank_sgd_tpu import benchlib
+    from gaussiank_sgd_tpu.compressors import DEFAULT_SELECTOR, get_compressor
+    from gaussiank_sgd_tpu.models import get_model
+    from gaussiank_sgd_tpu.ops.pallas_pack import (
+        gaussian_fused_compress_batched)
+    from gaussiank_sgd_tpu.parallel.bucketing import plan_for_params
+
+    # the kernel-path guarantee this artifact certifies (VERDICT r4 item 3)
+    spec = get_compressor(DEFAULT_SELECTOR, density=args.density)
+    assert spec.name == "gaussian_fused", spec.name
+    assert spec.batched_fn.func is gaussian_fused_compress_batched
+
+    import jax.numpy as jnp
+    mspec = get_model("transformer_lm", "ptb", dtype=jnp.bfloat16,
+                      **MODEL_KW)
+    variables = mspec.module.init(
+        {"params": jax.random.PRNGKey(0)},
+        jnp.zeros((2, MODEL_KW["seq_len"]), jnp.int32), train=False)
+    n_params = sum(int(x.size) for x in
+                   jax.tree_util.tree_leaves(variables["params"]))
+    assert n_params >= 200_000_000, n_params
+    plan = plan_for_params(variables["params"], args.density,
+                           args.bucket_size, policy="uniform")
+    assert plan.uniform and len(plan.buckets) > 1
+    del variables
+
+    t = benchlib.bench_model(
+        "transformer_lm", "ptb", args.batch, args.density,
+        [DEFAULT_SELECTOR], args.n_steps, rounds=args.rounds,
+        model_kwargs=MODEL_KW, bucket_policy="uniform",
+        bucket_size=args.bucket_size)
+    dr = t["_rounds"]["dense"]
+    sr = t["_rounds"][DEFAULT_SELECTOR]
+    ratios = [d / s for d, s in zip(dr, sr)]
+    dense_med = statistics.median(dr)
+
+    k_total = plan.total_k
+    bytes_sparse = 8 * k_total          # int32 idx + f32 val per pair
+    bytes_dense = 4 * n_params
+    out = {
+        "model": {"name": "transformer_lm", **MODEL_KW,
+                  "params": n_params, "batch": args.batch},
+        "plan": {"policy": "uniform", "bucket_size": args.bucket_size,
+                 "n_chunks": len(plan.buckets),
+                 "k_per_chunk": plan.buckets[0].k, "k_total": k_total},
+        "selector": DEFAULT_SELECTOR,
+        "kernel_path": "gaussian_fused_compress_batched (chunked grid)",
+        "density": args.density,
+        "dense_ms_median": round(1e3 * dense_med, 3),
+        "sparse_ms_median": round(1e3 * statistics.median(sr), 3),
+        "ratio_median": round(statistics.median(ratios), 4),
+        "ratio_min": round(min(ratios), 4),
+        "round_ratios": [round(r, 4) for r in ratios],
+        "mfu_dense": round(benchlib.mfu(t.get("_dense_step_flops"),
+                                        dense_med,
+                                        t.get("_peak_flops")) or -1, 4),
+        "bytes_per_step": {"sparse_pairs": bytes_sparse,
+                           "dense_equivalent": bytes_dense,
+                           "compression_x": round(bytes_dense /
+                                                  bytes_sparse, 1)},
+        "device": str(jax.devices()[0].device_kind),
+    }
+    os.makedirs(ARTIFACTS, exist_ok=True)
+    with open(os.path.join(ARTIFACTS, "scale_bench_200m.json"), "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

