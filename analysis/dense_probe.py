@@ -36,21 +36,24 @@ def main():
     p.add_argument("--density", type=float, default=0.001)
     p.add_argument("--model-kwargs", type=json.loads, default={},
                    help="JSON model ctor overrides, e.g. dropout/unroll")
+    p.add_argument("--comp", default=None,
+                   help="sparse compressor to time (default: the registry "
+                        "DEFAULT_SELECTOR)")
     args = p.parse_args()
 
     from gaussiank_sgd_tpu import benchlib
     from gaussiank_sgd_tpu.compressors import DEFAULT_SELECTOR
 
     model, dataset, batch, n_steps = CELLS[args.cell]
-    comps = [DEFAULT_SELECTOR] if args.sparse else []
+    comp = args.comp or DEFAULT_SELECTOR
     t = benchlib.bench_model(model, dataset, batch, args.density,
-                             comps or [DEFAULT_SELECTOR], n_steps,
+                             [comp], n_steps,
                              rounds=args.rounds,
                              model_kwargs=args.model_kwargs or None)
     dense_rounds = t["_rounds"]["dense"]
     dense_med = statistics.median(dense_rounds)
     out = {
-        "cell": args.cell,
+        "cell": args.cell, "comp": comp,
         "dense_ms_median": round(1e3 * dense_med, 3),
         "dense_ms_min": round(1e3 * min(dense_rounds), 3),
         "mfu_dense": round(benchlib.mfu(t.get("_dense_step_flops"),
@@ -60,7 +63,7 @@ def main():
                                    2),
     }
     if args.sparse:
-        sr = t["_rounds"][DEFAULT_SELECTOR]
+        sr = t["_rounds"][comp]
         ratios = [d / s for d, s in zip(dense_rounds, sr)]
         out["sparse_ms_median"] = round(
             1e3 * statistics.median(sr), 3)

@@ -33,6 +33,7 @@ SOURCES = ("bench_matrix_hidens.json", "bench_matrix_hidens_c5.json",
 def main():
     points = {}   # (config, compressor, density) -> cell
     meta = {}     # config -> {model, batch}
+    dense = {}    # config -> anchor from the FRESHEST source file
     for fname in SOURCES:
         path = os.path.join(ARTIFACTS, fname)
         if not os.path.exists(path):
@@ -48,18 +49,21 @@ def main():
                                "ratio_median_paired":
                                    cell.get("ratio_median_paired"),
                                "source": fname}
+            if cfg["cells"]:
+                # dense anchor: SOURCES is oldest-first, so the last file
+                # containing this config wins (freshest measurement)
+                dense[cfg["config"]] = {
+                    "density": 1.0,
+                    "ex_per_s_chip": round(
+                        1e3 * cfg["batch_per_chip"]
+                        / cfg["cells"][0]["dense_ms"], 1),
+                    "source": fname}
 
     curves = {}
     for (config, comp, density), cell in sorted(points.items()):
-        cfg = curves.setdefault(config, {**meta[config], "dense": {},
+        cfg = curves.setdefault(config, {**meta[config],
+                                         "dense": dense[config],
                                          "by_compressor": {}})
-        # dense anchor: examples/sec/chip of the dense step measured in the
-        # same run (density -> its dense_ms; keep the freshest per config)
-        bpc = cfg["batch_per_chip"]
-        cfg["dense"] = {"density": 1.0,
-                        "ex_per_s_chip": round(1e3 * bpc / cell["dense_ms"],
-                                               1),
-                        "source": cell["source"]}
         cfg["by_compressor"].setdefault(comp, []).append(
             {"density": density,
              "ex_per_s_chip": cell["ex_per_s_chip"],

@@ -19,8 +19,8 @@ import jax.numpy as jnp
 from scipy.special import ndtri  # host-side: threshold quantile is a
                                  # compile-time constant (density is static)
 
-from .base import (CompressResult, bisect_threshold, pack_by_mask,
-                   pack_by_threshold)
+from .base import (CompressResult, bisect_threshold, finish_pack,
+                   pack_by_mask, pack_by_threshold, select_by_mask)
 
 
 def gaussian_threshold_estimate(acc: jax.Array, density: float,
@@ -101,19 +101,27 @@ def gaussian_warm_compress(acc: jax.Array, k: int, state: jax.Array,
     usable = (state > 0) & (count_prev >= k // 4) & (count_prev <= 4 * k)
 
     def warm(_):
-        # magnitude-priority pack: bf16 key (half the HBM traffic of the
-        # f32 index key) and overflow drops the SMALLEST entries — see
+        # magnitude-priority selection: bf16 key (half the HBM traffic of
+        # the f32 index key) and overflow drops the SMALLEST entries — see
         # pack_by_mask. The cold path keeps index priority so it stays
         # bit-identical to the stateless gaussian reference path.
-        return pack_by_mask(acc, mask_prev, k, priority="magnitude"), state
+        si, v, ns = select_by_mask(acc, mask_prev, k, priority="magnitude")
+        return si, v, ns, state
 
     def cold(_):
         t0 = gaussian_threshold_estimate(acc, density, sigma_scale)
         t = bisect_threshold(abs_acc, k, t0, num_iters=10)
-        return pack_by_threshold(acc, t, k), t
+        si, v, ns = select_by_mask(acc, abs_acc > t, k)
+        return si, v, ns, t
 
-    result, t = jax.lax.cond(usable, warm, cold, operand=None)
-    ratio = (result.num_selected.astype(jnp.float32) + 1.0) / float(k + 1)
+    # only the k-sized selection goes through the cond; the n-sized
+    # residual is built ONCE outside (a big buffer returned from a cond
+    # branch pays a full copy at the boundary — measured ~1 HBM pass at
+    # 57M, r5)
+    sent_idx, val, nsel, t = jax.lax.cond(usable, warm, cold, operand=None)
+    comp, residual = finish_pack(acc, sent_idx, val)
+    result = CompressResult(comp, residual, nsel)
+    ratio = (nsel.astype(jnp.float32) + 1.0) / float(k + 1)
     t_new = t * jnp.clip(ratio ** gain, 0.25, 4.0)
     return result, t_new
 
@@ -157,11 +165,11 @@ def gaussian_warm_compress_batched(x: jax.Array, k: int, state: jax.Array,
     usable = (state > 0) & (count_prev >= k // 4) & (count_prev <= 4 * k)
 
     def warm(_):
-        # steady state: pack with the mask the count pass already built —
+        # steady state: select with the mask the count pass already built —
         # no second full-buffer compare (code-review r4)
-        res = jax.vmap(lambda xc, mc: pack_by_mask(
+        si, v, ns = jax.vmap(lambda xc, mc: select_by_mask(
             xc, mc, k, priority="magnitude"))(x, mask_prev)
-        return res, state
+        return si, v, ns, state
 
     def recover(_):
         def one(xc, ac):
@@ -170,12 +178,16 @@ def gaussian_warm_compress_batched(x: jax.Array, k: int, state: jax.Array,
 
         t_fresh = jax.vmap(one)(x, abs_x)
         t_eff = jnp.where(usable, state, t_fresh)
-        res = jax.vmap(lambda xc, ac, tc: pack_by_mask(
+        si, v, ns = jax.vmap(lambda xc, ac, tc: select_by_mask(
             xc, ac > tc, k, priority="magnitude"))(x, abs_x, t_eff)
-        return res, t_eff
+        return si, v, ns, t_eff
 
-    result, t_eff = jax.lax.cond(jnp.all(usable), warm, recover,
-                                 operand=None)
-    ratio = (result.num_selected.astype(jnp.float32) + 1.0) / float(k + 1)
+    # k-sized selection through the cond; [n_chunks, chunk] residual built
+    # once outside (see gaussian_warm_compress — cond-boundary copy)
+    sent_idx, val, nsel, t_eff = jax.lax.cond(jnp.all(usable), warm,
+                                              recover, operand=None)
+    comp, residual = jax.vmap(finish_pack)(x, sent_idx, val)
+    result = CompressResult(comp, residual, nsel)
+    ratio = (nsel.astype(jnp.float32) + 1.0) / float(k + 1)
     t_new = t_eff * jnp.clip(ratio ** gain, 0.25, 4.0)
     return result, t_new

@@ -16,31 +16,35 @@ serializes (~93 ms at 15M, r3 memory). This kernel solves compaction with a
 TPU-shaped two-level scheme:
 
   1. **In-kernel (one HBM pass)**: the flat buffer is viewed as
-     ``[rows, 128]`` and gridded into blocks of ``R`` rows. Each of the 128
-     lanes of a block owns a column of ``R`` elements. Per block the kernel
-     extracts the top-``S`` above-threshold entries *of each column* into a
-     fixed ``[S, 128]`` output tile (value + flat index), using S sublane
-     max-reductions over an int32 ranking key. The key is the f32 magnitude's
-     bit pattern with its low 11 mantissa bits replaced by the row index —
-     order-preserving to ~2^-12 relative, and it makes every key in a column
-     unique, so the winner is identified by ONE max-reduction (no tie-break
-     pass) and its row recovered from the key's low bits. The exact f32 value
-     is then recovered with a masked sum over the winner's one-hot.
-     Everything runs on VMEM-resident data: HBM traffic is exactly one read
-     of the buffer plus the (tiny) candidate tiles.
-  2. **In-XLA (small)**: the candidate buffer has ``nc = S*n/R`` slots —
-     256x smaller than the gradient at the contract density — so an *exact*
-     ``lax.top_k`` over candidate magnitudes picks the final k pairs in
-     f32 (strictly better truncation than the bf16 approx_max_k key the XLA
-     composite needs at n-scale).
+     ``[rows, 128]`` and gridded into blocks of ``R`` rows; inside a block
+     the rows regroup into SEGMENTS of ``SEG`` rows, and the kernel emits
+     the single largest above-threshold entry of every (segment, lane)
+     cell — ONE segmented max-reduction over an int32 ranking key instead
+     of a sequential extraction loop. (The r4 kernel pulled top-8 per
+     column via 8 dependent max/mask/sum rounds — ~35 vector passes per
+     block; profiling in r5 showed that loop VPU-bound at ~4.8 ms at 57M,
+     6x the pure HBM read. The segmented form is ~8 passes, measured
+     1.8 ms.) The key is the f32 magnitude's bit pattern with its low
+     log2(SEG) mantissa bits replaced by the row-in-segment — order-
+     preserving to ~2^-(23-log2(SEG)) relative, unique within the cell, so
+     the winner falls out of one max and its row decodes from the key's
+     low bits. The exact f32 value is recovered by a masked segment-sum
+     over the winner's one-hot. HBM traffic is exactly one read of the
+     buffer plus the (tiny) candidate tiles.
+  2. **In-XLA (small)**: the candidate buffer has ``nc = n/SEG`` slots
+     (64x smaller than the gradient at the contract density), so a top-k
+     over candidate magnitudes — exact ``lax.top_k`` up to 512k
+     candidates, ``approx_max_k`` beyond (misses defer to EF) — picks the
+     final k pairs in f32.
 
 Selection contract vs ``pack_by_mask(priority="magnitude")``: identical mask
 (``|acc| > t``), identical exact EF bookkeeping (the caller zeroes exactly
-the k sent entries; everything else — including any entry beyond a column's
-S-slot cap — stays in the residual and is re-selected next step). The
-geometry (R, S) is chosen so the per-column above-threshold count lambda =
-R*density keeps cap overflow below ~1% of selected entries at supported
-densities; overflow loses nothing (EF), it only defers.
+the k sent entries; everything else — including any entry beyond a cell's
+one-slot cap — stays in the residual and is re-selected next step). ``SEG``
+shrinks with density so the per-cell above-threshold count lambda =
+SEG*density stays <= ~0.5: cap overflow P(X>=2|lambda) <= ~9% of cells at
+the ceiling, ~0.2% at the contract density; overflow loses nothing (EF),
+it only defers.
 
 ``num_selected`` is the exact above-threshold count, accumulated in SMEM
 across the (sequential) grid — the same observability the reference logs.
@@ -65,68 +69,74 @@ except Exception:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-from ..compressors.base import (_EXACT_PACK_MAX, CompressedGrad,
-                                CompressResult)
+from ..compressors.base import (CompressedGrad, CompressResult,
+                                finish_pack)
 
 _LANES = 128
-_S = 8            # candidate slots per block-column (= one f32 sublane tile)
-_ROW_BITS = 11    # low mantissa bits of the key carry the row id (R <= 2048)
-_ROW_MASK = (1 << _ROW_BITS) - 1
+_MAX_SEG = 64     # largest segment span (contract-density geometry, n/64
+                  # candidates); shrinks with density — see segment_span
+_DENSITY_CEIL = 1.0 / 32   # capacity ceiling (unchanged from r4's S/R)
+
+
+def segment_span(density: float) -> int:
+    """SEG: rows per one-slot candidate cell, by density.
+
+    Capacity is 1/SEG of n, so SEG must satisfy ``density <= 1/SEG``; the
+    chosen rule ``SEG*density <= 0.5`` keeps >= 2x headroom for the warm
+    controller's count band and bounds cap overflow P(X>=2 | lambda) at
+    ~9% of cells (lambda 0.5) worst case, ~0.2% at the contract density
+    (lambda 0.064)."""
+    seg = _MAX_SEG
+    while seg > 8 and density * seg > 0.5:
+        seg //= 2
+    return seg
 
 
 def rows_per_block(density: float) -> int:
-    """Reduction span R by density so lambda = R*density stays ~<= 4.
-
-    Cap overflow per column is Poisson: P(X > S | lambda). With S=8,
-    R=1024 @ density 0.002 gives lambda ~2.05 (overflow ~2e-4 of
-    columns) and a candidate buffer of n/128; R=256 @ density 0.02 gives
-    lambda ~5.1 (overflow ~7%, still EF-safe: capped entries stay in the
-    residual). The hard ceiling is candidate CAPACITY, not overflow: the
-    buffer holds S/R of n slots, so k = ceil(density*n) fits only while
-    density <= S/R = 0.03125 for R=256 (ADVICE r4: the old 0.05 bound let
-    densities in (0.03125, 0.05] route every call to the XLA warm path
-    while keeping the 'gaussian_fused' name). supports_density is the
-    single source of truth for that bound.
-
-    R=2048 (half the phase-2 top-k work) was tried and measured SLOWER
-    end-to-end on v5e: the [R,128] f32 block + int32 key + intermediates
-    approach the ~16 MB VMEM budget at R=2048, costing the pipeline its
-    double-buffering headroom — the HBM read stops overlapping the
-    extraction loop. R=1024 keeps ~3 MB live per grid step.
-    """
-    if density <= 0.002:
-        return 1024
-    if supports_density(density):
-        return 256
-    raise ValueError(
-        f"fused select+pack supports density <= {_S / 256}, got {density}")
+    """Grid-block span R (rows per grid step) — a VMEM budget, not a
+    statistics choice (segmentation handles density now; R just sets how
+    much of the buffer is resident per step). [1024,128] f32 + i32 key +
+    intermediates keep ~3 MB live — comfortable double-buffering headroom
+    inside the ~16 MB VMEM."""
+    if not supports_density(density):
+        raise ValueError(
+            f"fused select+pack supports density <= {_DENSITY_CEIL}, "
+            f"got {density}")
+    return 1024
 
 
 def supports_density(density: float) -> bool:
     """True iff the kernel geometry can emit k = density*n pairs.
 
-    The R=256 geometry's candidate buffer has S/R = 8/256 = 0.03125 of n
-    slots — the capacity ceiling. Beyond it ``gaussian_fused_compress``
-    would route every call to the XLA warm path, so the registry must
-    rename the spec instead (one label, one program)."""
-    return density <= _S / 256
+    At the 1/32 ceiling the SEG=16 geometry holds 1/16 of n candidate
+    slots >= k. Beyond it ``gaussian_fused_compress`` would route every
+    call to the XLA warm path, so the registry must rename the spec
+    instead (one label, one program)."""
+    return density <= _DENSITY_CEIL
 
 
-def _chunk_geometry(chunk: int, density: float) -> Tuple[int, int, int]:
-    """(R, blocks_per_chunk, candidate_capacity) for a chunk of ``chunk``
-    elements at ``density`` — the single source of the R-cap rule (see
-    fused_select_candidates_chunked) so capacity checks agree with the
-    geometry the kernel actually runs."""
+def _chunk_geometry(chunk: int,
+                    density: float) -> Tuple[int, int, int, int]:
+    """(R, SEG, blocks_per_chunk, candidate_capacity) for a chunk of
+    ``chunk`` elements at ``density`` — the single source of the geometry
+    rules so capacity checks agree with what the kernel actually runs.
+
+    R is capped at the chunk's own rows (rounded up to a SEG multiple):
+    without the cap a uniform plan's small chunks would zero-pad to a full
+    1024-row block and the kernel's HBM pass would read up to 4x zeros
+    (code-review r5)."""
     R = rows_per_block(density)
+    seg = segment_span(density)
     rows_total = -(-chunk // _LANES)
     if rows_total < R:
-        R = max(8, -(-rows_total // 8) * 8)
+        R = max(seg, -(-rows_total // seg) * seg)
     bpc = -(-chunk // (R * _LANES))
-    return R, bpc, _S * bpc * _LANES
+    return R, seg, bpc, (R // seg) * bpc * _LANES
 
 
-def _select_kernel(x_ref, t_ref, val_ref, idx_ref, count_ref, *, rows: int):
-    """One grid step: extract top-S above-threshold entries per column.
+def _select_kernel(x_ref, t_ref, val_ref, idx_ref, count_ref, *,
+                   rows: int, seg: int):
+    """One grid step: the largest above-threshold entry per (segment, lane).
 
     Grid is ``(n_chunks, blocks_per_chunk)`` — the chunk axis is what makes
     the kernel compatible with uniform bucket plans (VERDICT r4 item 3: the
@@ -139,7 +149,7 @@ def _select_kernel(x_ref, t_ref, val_ref, idx_ref, count_ref, *, rows: int):
 
     x_ref: [R, 128] f32 block of this chunk's buffer view.
     t_ref: [1, 1] f32 — THIS chunk's threshold in SMEM.
-    val_ref/idx_ref: [S, 128] candidate tiles for this block.
+    val_ref/idx_ref: [R//seg, 128] candidate tiles for this block.
     count_ref: [1, 1] i32 SMEM accumulator (exact above-threshold count),
     one slot per chunk, carried across the chunk's sequential blocks.
     """
@@ -155,28 +165,33 @@ def _select_kernel(x_ref, t_ref, val_ref, idx_ref, count_ref, *, rows: int):
     mask = ax > t
     count_ref[0, 0] += jnp.sum(mask.astype(jnp.int32))
 
-    rowid = lax.broadcasted_iota(jnp.int32, (rows, _LANES), 0)
-    lane = lax.broadcasted_iota(jnp.int32, (1, _LANES), 1)
+    nseg = rows // seg
+    seg_mask = seg - 1
+    rowid = lax.broadcasted_iota(jnp.int32, (rows, _LANES), 0) & seg_mask
     # int32 ranking key: positive-f32 bit pattern (int compare == float
-    # compare for non-negative floats), low bits replaced by the row id so
-    # every in-column key is unique. 0 = "not selected" sentinel; a selected
-    # element whose magnitude bits round to 0 (subnormal ~<1e-42 in row 0)
-    # would collide with the sentinel and stay in the residual — harmless.
+    # compare for non-negative floats), low log2(seg) bits replaced by the
+    # row-in-segment so every in-cell key is unique. 0 = "not selected"
+    # sentinel; a selected element whose magnitude bits round to 0
+    # (subnormal ~<1e-43) would collide with the sentinel and stay in the
+    # residual — harmless.
     bits = lax.bitcast_convert_type(ax, jnp.int32)
-    key = jnp.where(mask, (bits & ~_ROW_MASK) | rowid, 0)
+    key = jnp.where(mask, (bits & ~seg_mask) | rowid, 0)
 
+    key3 = key.reshape(nseg, seg, _LANES)
+    top = jnp.max(key3, axis=1)                            # [nseg, 128]
+    valid = top > 0
+    win = (key3 == top[:, None, :]) & valid[:, None, :]    # one-hot per cell
+    # exact f32 value via the winner's one-hot (the key itself only keeps
+    # the top 23-log2(seg) magnitude bits)
+    val = jnp.sum(jnp.where(win, x.reshape(nseg, seg, _LANES), 0.0), axis=1)
     base = i * rows  # first CHUNK-LOCAL flat row of this block
-    for s in range(_S):
-        top = jnp.max(key, axis=0, keepdims=True)          # [1, 128]
-        win = key == jnp.broadcast_to(top, key.shape)      # one-hot per col
-        win = win & (top > 0)
-        val = jnp.sum(jnp.where(win, x, 0.0), axis=0, keepdims=True)
-        r_win = top & _ROW_MASK
-        flat_idx = (base + r_win) * _LANES + lane
-        valid = top > 0
-        val_ref[s, :] = jnp.where(valid, val, 0.0)[0]
-        idx_ref[s, :] = jnp.where(valid, flat_idx, 0)[0]
-        key = jnp.where(win, 0, key)
+    seg_row = (base
+               + lax.broadcasted_iota(jnp.int32, (nseg, _LANES), 0) * seg
+               + (top & seg_mask))
+    lane = lax.broadcasted_iota(jnp.int32, (nseg, _LANES), 1)
+    flat_idx = seg_row * _LANES + lane
+    val_ref[:] = jnp.where(valid, val, 0.0)
+    idx_ref[:] = jnp.where(valid, flat_idx, 0)
 
 
 def fused_select_candidates_chunked(
@@ -196,13 +211,8 @@ def fused_select_candidates_chunked(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     n_chunks, chunk = x2d.shape
-    # _chunk_geometry caps the reduction span at the chunk's own rows:
-    # density <= 0.002 picks R=1024, but a uniform plan's chunk may hold
-    # fewer rows — without the cap every chunk would zero-pad to a full
-    # R*128 block and the kernel's HBM pass would read up to 4x zeros
-    # (code-review r5). Capacity is unchanged (bpc == 1 either way when
-    # the cap fires); the smaller R also lowers per-column lambda — safe.
-    R, bpc, _ = _chunk_geometry(chunk, density)
+    R, seg, bpc, nc = _chunk_geometry(chunk, density)
+    nseg = R // seg
     block = R * _LANES
     chunk_pad = bpc * block
     x = jnp.pad(x2d.astype(jnp.float32),
@@ -211,7 +221,7 @@ def fused_select_candidates_chunked(
     space = pltpu.VMEM if (_HAS_PLTPU and not interpret) else None
     smem = pltpu.SMEM if (_HAS_PLTPU and not interpret) else None
     vals, idxs, counts = pl.pallas_call(
-        functools.partial(_select_kernel, rows=R),
+        functools.partial(_select_kernel, rows=R, seg=seg),
         grid=(n_chunks, bpc),
         in_specs=[
             pl.BlockSpec((R, _LANES), lambda c, i: (c * bpc + i, 0),
@@ -219,27 +229,25 @@ def fused_select_candidates_chunked(
             pl.BlockSpec((1, 1), lambda c, i: (c, 0), memory_space=smem),
         ],
         out_specs=(
-            pl.BlockSpec((_S, _LANES), lambda c, i: (0, c * bpc + i),
+            pl.BlockSpec((nseg, _LANES), lambda c, i: (c * bpc + i, 0),
                          memory_space=space),
-            pl.BlockSpec((_S, _LANES), lambda c, i: (0, c * bpc + i),
+            pl.BlockSpec((nseg, _LANES), lambda c, i: (c * bpc + i, 0),
                          memory_space=space),
             pl.BlockSpec((1, 1), lambda c, i: (c, 0), memory_space=smem),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((_S, n_chunks * bpc * _LANES), jnp.float32),
-            jax.ShapeDtypeStruct((_S, n_chunks * bpc * _LANES), jnp.int32),
+            jax.ShapeDtypeStruct((n_chunks * bpc * nseg, _LANES),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((n_chunks * bpc * nseg, _LANES),
+                                 jnp.int32),
             jax.ShapeDtypeStruct((n_chunks, 1), jnp.int32),
         ),
         interpret=interpret,
     )(x, thresholds.astype(jnp.float32).reshape(n_chunks, 1))
-    # columns of the [S, n_chunks*bpc*128] tiles are (chunk, block, lane):
-    # regroup to one [nc] candidate list per chunk
-    nc = _S * bpc * _LANES
-    vals = jnp.moveaxis(vals.reshape(_S, n_chunks, bpc * _LANES),
-                        1, 0).reshape(n_chunks, nc)
-    idxs = jnp.moveaxis(idxs.reshape(_S, n_chunks, bpc * _LANES),
-                        1, 0).reshape(n_chunks, nc)
-    return vals, idxs, counts[:, 0]
+    # rows of the output tiles are (chunk, block, segment) — contiguous per
+    # chunk, so the per-chunk candidate list is a plain reshape
+    return (vals.reshape(n_chunks, nc), idxs.reshape(n_chunks, nc),
+            counts[:, 0])
 
 
 def fused_select_candidates(
@@ -248,8 +256,8 @@ def fused_select_candidates(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One kernel pass: (cand_values [nc], cand_indices [nc], count).
 
-    ``acc`` is the flat f32 EF accumulator; candidates are the top-S
-    above-threshold entries of each [R]-row column (see module docstring).
+    ``acc`` is the flat f32 EF accumulator; candidates are the largest
+    above-threshold entry of each (SEG-row, lane) cell (module docstring).
     Invalid slots hold (value 0, index 0). The single-buffer form is the
     ``n_chunks == 1`` case of :func:`fused_select_candidates_chunked`
     (chunk-local index == global flat index).
@@ -259,33 +267,65 @@ def fused_select_candidates(
     return vals[0], idxs[0], counts[0]
 
 
+_EXACT_CAND_MAX = 1 << 19
+
+
 def _cand_top_k(vals: jax.Array, k: int):
-    """Exact f32 top-k over the candidate magnitudes when the buffer is
-    small enough (it is at all supported densities <= 0.02 on <= ~60M
-    params), approx_max_k beyond — same switch as base.pack_by_mask."""
+    """Top-k over the candidate magnitudes: exact ``lax.top_k`` while the
+    buffer is small (sort-based top_k is TPU-slow — measured ~1.1 ms at
+    890k candidates vs ~0.8 ms approx), ``approx_max_k`` (recall 0.95)
+    beyond — the ~5% it misses at the k-boundary stays in the EF residual
+    and is re-selected next step."""
     key = jnp.abs(vals)
-    if vals.shape[0] <= _EXACT_PACK_MAX:
+    if vals.shape[0] <= _EXACT_CAND_MAX:
         return lax.top_k(key, k)
     return lax.approx_max_k(key, k, recall_target=0.95)
+
+
+def _select_candidates_topk(vals: jax.Array, idxs: jax.Array, k: int,
+                            n: int) -> Tuple[jax.Array, jax.Array]:
+    """The selection half of the fused pack: ``(sent_idx [k], val [k])``
+    with the out-of-range sentinel ``n`` on invalid slots (kv > 0 validity
+    rule: a selected subnormal whose key rounds to the 0 sentinel stays in
+    the residual). Small outputs only, so stateful wrappers can route the
+    result through a ``lax.cond`` without paying the big-buffer
+    cond-boundary copy (see base.select_by_mask)."""
+    kv, kpos = _cand_top_k(vals, k)
+    valid = kv > 0
+    val = jnp.where(valid, vals[kpos], 0.0)
+    sent_idx = jnp.where(valid, idxs[kpos], n).astype(jnp.int32)
+    return sent_idx, val
+
+
+def _controller_update(state: jax.Array, count: jax.Array, val: jax.Array,
+                       valid: jax.Array, k: int, gain: float) -> jax.Array:
+    """Next carried threshold (shared by the flat and batched fused forms).
+
+    Warm (state > 0): multiplicative nudge toward count == k, clipped to
+    [1/4, 4] per step — same controller as gaussian_warm_compress.
+    Cold (state <= 0): adopt the smallest SENT magnitude — the k-th
+    largest candidate, a free near-ideal threshold estimate (see
+    gaussian_fused_compress docstring). An all-invalid selection (dead
+    bucket) bootstraps to a tiny positive value so the controller can
+    re-raise it multiplicatively when gradients appear.
+    """
+    ratio = (count.astype(jnp.float32) + 1.0) / float(k + 1)
+    t_warm = state * jnp.clip(ratio ** gain, 0.25, 4.0)
+    mags = jnp.where(valid, jnp.abs(val.astype(jnp.float32)), jnp.inf)
+    kth = jnp.min(mags, axis=-1)
+    bootstrap = jnp.where(jnp.isfinite(kth), kth, jnp.float32(1e-8))
+    return jnp.where(state > 0, t_warm, bootstrap).astype(state.dtype)
 
 
 def _pack_candidates(vals: jax.Array, idxs: jax.Array, buf: jax.Array,
                      k: int) -> Tuple[CompressedGrad, jax.Array]:
     """Top-k pack of a candidate buffer against ``buf`` (the chunk the
-    candidates came from): (CompressedGrad, EF residual).
-
-    The shared tail of every fused path — ONE copy so the validity rule
-    (kv > 0; a selected subnormal whose key rounds to the 0 sentinel stays
-    in the residual) and the drop-mode EF zeroing can never diverge between
-    the flat and batched forms (code-review r5). Invalid slots pack (0, 0)
-    and scatter out-of-range (dropped)."""
-    n = buf.shape[0]
-    kv, kpos = _cand_top_k(vals, k)
-    valid = kv > 0
-    idx = jnp.where(valid, idxs[kpos], 0).astype(jnp.int32)
-    val = jnp.where(valid, vals[kpos], 0.0).astype(buf.dtype)
-    residual = buf.at[jnp.where(valid, idx, n)].set(0.0, mode="drop")
-    return CompressedGrad(idx, val), residual
+    candidates came from): (CompressedGrad, EF residual). The shared tail
+    of every fused path — ONE copy so the validity rule and the drop-mode
+    EF zeroing can never diverge between the flat and batched forms
+    (code-review r5)."""
+    sent_idx, val = _select_candidates_topk(vals, idxs, k, buf.shape[0])
+    return finish_pack(buf, sent_idx, val.astype(buf.dtype))
 
 
 def fused_select_pack(acc: jax.Array, k: int, threshold: jax.Array,
@@ -319,22 +359,33 @@ def gaussian_fused_compress(acc: jax.Array, k: int, state: jax.Array,
                             gain: float = 0.18,
                             interpret: Optional[bool] = None,
                             ) -> Tuple[CompressResult, jax.Array]:
-    """gaussian_warm with the fused Pallas select+pack on the hot path.
+    """Warm-threshold GaussianK with the fused Pallas select+pack — and NO
+    branches on the hot path.
 
-    Same stateful contract as ``gaussian_warm_compress``
-    (compressors/gaussian.py): the threshold is carried across steps, the
-    multiplicative controller nudges it toward count == k, and a cold start
-    (state <= 0 or count outside [k/4, 4k]) falls back to the full Gaussian
-    estimate + bisection for that step. Differences on the warm path:
+    Stateful contract matches ``gaussian_warm_compress``
+    (compressors/gaussian.py): the threshold is carried across steps and a
+    multiplicative controller nudges it toward count == k. The r5 redesign
+    removes the cold-start/recovery ``lax.cond`` entirely (measured: ANY
+    conditional carrying the n-sized cold computation costs ~1 extra HBM
+    pass per step at 57M even when never taken):
 
-      * selection+pack is ONE kernel pass + a small exact top-k, instead of
-        a mask pass + n-scale bf16 approx_max_k + gather;
-      * the above-threshold count used by the controller comes from the
-        kernel (exact), not from a separate mask reduction.
+      * every step is the SAME three-op program: kernel candidate
+        extraction -> small top-k -> finish_pack;
+      * cold start (state <= 0): the kernel's mask ``|x| > t`` at t <= 0
+        passes everything, so the candidates are exactly the per-cell
+        maxima and the top-k of THOSE is already a near-exact first
+        selection (collision losses ~3% at contract shapes, EF-deferred).
+        The k-th candidate magnitude — free from the top-k we just ran —
+        is then a near-ideal threshold, adopted as the next state: one
+        step to fully warm, no Gaussian estimate, no bisection;
+      * band exits (count drifted from k): the clipped multiplicative
+        update (x4 per step max) walks back in O(log) steps; meanwhile
+        selection degrades gracefully (count < k under-fills the packed
+        buffer; count >> k defers overflow to the residual). Exactness of
+        EF bookkeeping never depends on the threshold's quality.
     """
-    from ..compressors.base import bisect_threshold, pack_by_threshold
-    from ..compressors.gaussian import (gaussian_threshold_estimate,
-                                        gaussian_warm_compress)
+    from ..compressors.base import finish_pack
+    from ..compressors.gaussian import gaussian_warm_compress
 
     n = acc.shape[0]
     if not supports_density(density):
@@ -343,7 +394,7 @@ def gaussian_fused_compress(acc: jax.Array, k: int, state: jax.Array,
         # path rather than raising from rows_per_block
         return gaussian_warm_compress(acc, k, state, rng, density=density,
                                       sigma_scale=sigma_scale, gain=gain)
-    _, _, nc = _chunk_geometry(n, density)
+    _, _, _, nc = _chunk_geometry(n, density)
     if k > nc:
         # trace-time geometry check: only reachable for direct calls with a
         # k far above ceil(density*n) — route to the XLA warm path instead
@@ -353,22 +404,10 @@ def gaussian_fused_compress(acc: jax.Array, k: int, state: jax.Array,
 
     vals, idxs, count = fused_select_candidates(acc, state, density,
                                                 interpret)
-    usable = (state > 0) & (count >= k // 4) & (count <= 4 * k)
-
-    def warm(_):
-        comp, residual = _pack_candidates(vals, idxs, acc, k)
-        return CompressResult(comp, residual, count), state
-
-    def cold(_):
-        abs_acc = jnp.abs(acc)
-        t0 = gaussian_threshold_estimate(acc, density, sigma_scale)
-        t = bisect_threshold(abs_acc, k, t0, num_iters=10)
-        return pack_by_threshold(acc, t, k), t
-
-    result, t = lax.cond(usable, warm, cold, operand=None)
-    ratio = (result.num_selected.astype(jnp.float32) + 1.0) / float(k + 1)
-    t_new = t * jnp.clip(ratio ** gain, 0.25, 4.0)
-    return result, t_new
+    sent_idx, val = _select_candidates_topk(vals, idxs, k, n)
+    comp, residual = finish_pack(acc, sent_idx, val.astype(acc.dtype))
+    t_new = _controller_update(state, count, val, sent_idx < n, k, gain)
+    return CompressResult(comp, residual, count), t_new
 
 
 def gaussian_fused_compress_batched(
@@ -383,15 +422,15 @@ def gaussian_fused_compress_batched(
     ``pallas_call`` (grid leading axis = chunk, per-chunk thresholds in
     SMEM) replaces the per-chunk vmap that the sequential-grid kernel could
     not support, so ``DEFAULT_SELECTOR`` keeps its Pallas select+pack at
-    exactly the scale where uniform plans become necessary. Cold-lane
-    recovery mirrors ``gaussian_warm_compress_batched`` (gaussian.py): the
-    steady-state program is ONLY kernel + per-chunk exact top-k; a scalar
-    ``lax.cond`` gates the vmapped estimate+bisection recovery, and only
-    unusable lanes adopt the fresh threshold.
+    exactly the scale where uniform plans become necessary. Branch-free
+    like the flat form: every lane runs kernel -> top-k -> finish_pack
+    every step; cold lanes bootstrap their threshold from their own k-th
+    candidate magnitude (``_controller_update``) with no cross-lane
+    coupling — a persistently-cold lane can never drag warm lanes into a
+    recovery path, because no recovery path exists.
     """
-    from ..compressors.base import bisect_threshold, pack_by_mask
-    from ..compressors.gaussian import (gaussian_threshold_estimate,
-                                        gaussian_warm_compress_batched)
+    from ..compressors.base import finish_pack
+    from ..compressors.gaussian import gaussian_warm_compress_batched
 
     n_chunks, chunk = x.shape
     if not supports_density(density):
@@ -402,7 +441,7 @@ def gaussian_fused_compress_batched(
                                               density=density,
                                               sigma_scale=sigma_scale,
                                               gain=gain)
-    _, _, nc_chunk = _chunk_geometry(chunk, density)
+    _, _, _, nc_chunk = _chunk_geometry(chunk, density)
     if k > nc_chunk:
         # trace-time geometry check, as in gaussian_fused_compress
         return gaussian_warm_compress_batched(x, k, state, rng,
@@ -411,31 +450,10 @@ def gaussian_fused_compress_batched(
                                               gain=gain)
     vals, idxs, counts = fused_select_candidates_chunked(x, state, density,
                                                          interpret)
-    usable = ((state > 0) & (counts >= k // 4) & (counts <= 4 * k))
-
-    def warm(_):
-        comp, residual = jax.vmap(
-            lambda vc, ic, xc: _pack_candidates(vc, ic, xc, k))(vals, idxs, x)
-        return CompressResult(comp, residual, counts), state
-
-    def recover(_):
-        # rare branch: per-lane Gaussian estimate + bisection, vmapped; warm
-        # lanes keep their carried thresholds (and the XLA mask pack here is
-        # exact for them too — the kernel candidates are simply unused for
-        # one step)
-        abs_x = jnp.abs(x)
-
-        def one(xc, ac):
-            t0 = gaussian_threshold_estimate(xc, density, sigma_scale)
-            return bisect_threshold(ac, k, t0, num_iters=10)
-
-        t_fresh = jax.vmap(one)(x, abs_x)
-        t_eff = jnp.where(usable, state, t_fresh)
-        res = jax.vmap(lambda xc, ac, tc: pack_by_mask(
-            xc, ac > tc, k, priority="magnitude"))(x, abs_x, t_eff)
-        return res, t_eff
-
-    result, t_eff = lax.cond(jnp.all(usable), warm, recover, operand=None)
-    ratio = (result.num_selected.astype(jnp.float32) + 1.0) / float(k + 1)
-    t_new = t_eff * jnp.clip(ratio ** gain, 0.25, 4.0)
-    return result, t_new
+    sent_idx, val = jax.vmap(
+        lambda vc, ic: _select_candidates_topk(vc, ic, k, chunk))(vals, idxs)
+    val = val.astype(x.dtype)
+    comp, residual = jax.vmap(finish_pack)(x, sent_idx, val)
+    t_new = _controller_update(state, counts, val, sent_idx < chunk, k,
+                               gain)
+    return CompressResult(comp, residual, counts), t_new

@@ -15,9 +15,10 @@ import pytest
 
 from gaussiank_sgd_tpu.compressors.base import pack_by_mask
 from gaussiank_sgd_tpu.ops.pallas_pack import (
-    _LANES, _S, fused_select_candidates, fused_select_candidates_chunked,
-    fused_select_pack, gaussian_fused_compress,
-    gaussian_fused_compress_batched, rows_per_block)
+    _LANES, _chunk_geometry, fused_select_candidates,
+    fused_select_candidates_chunked, fused_select_pack,
+    gaussian_fused_compress, gaussian_fused_compress_batched,
+    rows_per_block, segment_span)
 
 
 def _acc(n, seed=0, scale=1.0):
@@ -52,32 +53,54 @@ def test_candidates_exact_count_and_values(n):
     assert len(np.unique(i[valid])) == valid.sum()
 
 
+def _distinct_cell_indices(n, count, density):
+    """Flat indices in pairwise-DISTINCT (segment, lane) cells: consecutive
+    flat indices share a row (different lanes); new segments start every
+    seg*128 elements. Cell collisions are the kernel's documented one-slot
+    cap — these helpers construct data where it cannot fire."""
+    seg = segment_span(density)
+    out = []
+    base = 0
+    while len(out) < count:
+        take = min(_LANES, count - len(out))
+        out.extend(range(base, base + take))
+        base += seg * _LANES                   # next segment
+        assert base < n, "n too small for distinct-cell layout"
+    return np.asarray(out[:count])
+
+
 def test_pack_matches_xla_magnitude_pack_without_overflow():
-    # density/threshold chosen so no column holds > S above-threshold
-    # entries (R=2048 rows/block at this density -> lambda ~0.7/column,
-    # P(overflow) ~1e-8): the candidate set then equals the full mask and
-    # the fused pack must select the IDENTICAL set as
+    # Above-threshold entries placed in pairwise-distinct cells (no
+    # one-slot cap can fire): the candidate set then equals the full mask
+    # and the fused pack must select the IDENTICAL set as
     # pack_by_mask("magnitude")
-    acc = _acc(200_000, seed=1)
+    n, n_hot, k = 200_000, 300, 800
+    rng = np.random.default_rng(1)
+    a = rng.normal(0, 0.3, n).astype(np.float32)      # background << t
+    hot = _distinct_cell_indices(n, n_hot, 0.001)
+    a[hot] = rng.uniform(4.0, 9.0, n_hot) * rng.choice([-1, 1], n_hot)
+    acc = jnp.asarray(a)
     t = jnp.float32(3.5)
-    k = 800
     r_fused = fused_select_pack(acc, k, t, density=0.001)
     r_ref = pack_by_mask(acc, jnp.abs(acc) > t, k, priority="magnitude")
     fi = np.asarray(r_fused.compressed.indices)
     fv = np.asarray(r_fused.compressed.values)
     ri = np.asarray(r_ref.compressed.indices)
     rv = np.asarray(r_ref.compressed.values)
-    assert set(fi[fv != 0]) == set(ri[rv != 0])
+    assert set(fi[fv != 0]) == set(ri[rv != 0]) == set(hot)
     assert int(r_fused.num_selected) == int(r_ref.num_selected)
     _ef_ok(acc, r_fused)
 
 
 def test_truncation_drops_smallest_magnitudes():
-    acc = _acc(100_000, seed=2)
+    n, n_hot, k = 100_000, 120, 50
+    rng = np.random.default_rng(2)
+    a = rng.normal(0, 0.3, n).astype(np.float32)
+    hot = _distinct_cell_indices(n, n_hot, 0.001)     # no cap collisions
+    a[hot] = np.linspace(2.5, 8.0, n_hot) * rng.choice([-1, 1], n_hot)
+    acc = jnp.asarray(a)
     t = jnp.float32(2.0)          # far more than k above threshold
-    k = 50
     r = fused_select_pack(acc, k, t, density=0.001)
-    a = np.asarray(acc)
     val = np.asarray(r.compressed.values)
     assert (val != 0).sum() == k  # truncated to exactly k
     # magnitude-priority contract: the packed k are the k largest |acc|
@@ -87,24 +110,23 @@ def test_truncation_drops_smallest_magnitudes():
     _ef_ok(acc, r)
 
 
-def test_column_overflow_defers_to_residual():
-    # Force one column far past its S-slot cap: elements with flat index
-    # i*128 (column 0 of every row) all large. The kernel may emit only S
-    # of them per R-row block — the rest MUST stay in the residual.
-    R = rows_per_block(0.01)
-    n = R * _LANES  # one block -> one column cap per column
+def test_cell_overflow_defers_to_residual():
+    # Force one (segment, lane) cell past its one-slot cap: several large
+    # entries in lane 0 of the SAME segment. The kernel emits only the
+    # largest per cell — the rest MUST stay in the residual.
+    seg = segment_span(0.01)
+    n = rows_per_block(0.01) * _LANES
     a = np.zeros(n, np.float32)
-    hot = np.arange(0, n, _LANES)[: 3 * _S]  # 3*S entries, all in column 0
-    a[hot] = 10.0 + np.arange(len(hot))      # distinct magnitudes
+    hot = np.arange(0, seg * _LANES, _LANES)[:3]  # 3 entries, one cell
+    a[hot] = 10.0 + np.arange(len(hot))           # distinct magnitudes
     acc = jnp.asarray(a)
     k = len(hot)
     r = fused_select_pack(acc, k, jnp.float32(1.0), density=0.01)
     val = np.asarray(r.compressed.values)
     idx = np.asarray(r.compressed.indices)
     valid = val != 0
-    assert valid.sum() == _S                 # cap respected
-    # the S sent are the S largest of the column
-    assert set(idx[valid]) == set(hot[-_S:])
+    assert valid.sum() == 1                  # one-slot cap respected
+    assert set(idx[valid]) == {hot[-1]}      # the largest of the cell
     # count is still the exact mask count (pre-cap observability)
     assert int(r.num_selected) == len(hot)
     _ef_ok(acc, r)                           # nothing lost
@@ -135,8 +157,10 @@ def test_warm_cold_routing_and_controller():
 def test_k_beyond_candidate_capacity_falls_back():
     # direct call with k >> ceil(density*n): geometry cannot hold k
     # candidates, so the fn must route to the XLA warm path, not truncate
-    acc = _acc(re_n := rows_per_block(0.001) * _LANES, seed=4)
-    k = _S * _LANES + 1            # one block's nc is _S*_LANES
+    n = rows_per_block(0.001) * _LANES
+    acc = _acc(n, seed=4)
+    _, _, _, nc = _chunk_geometry(n, 0.001)
+    k = nc + 1                     # one more than the candidate capacity
     res, _t = gaussian_fused_compress(acc, k, jnp.float32(0.1),
                                       density=0.001)
     assert res.compressed.indices.shape[0] == k
@@ -170,15 +194,19 @@ def test_small_chunk_caps_reduction_span():
     every chunk pads to a full 131072-element block and the kernel reads
     up to 4x zeros). With the cap the geometry still emits every
     above-threshold entry (lambda tiny), with chunk-local indices."""
-    from gaussiank_sgd_tpu.ops.pallas_pack import _chunk_geometry
-
     chunk = 32_768                       # 256 rows < R=1024
-    R, bpc, nc = _chunk_geometry(chunk, 0.001)
-    assert R == 256 and bpc == 1 and nc == _S * _LANES
+    R, seg, bpc, nc = _chunk_geometry(chunk, 0.001)
+    assert R == 256 and seg == 64 and bpc == 1
+    assert nc == (R // seg) * _LANES
 
     rng = np.random.default_rng(23)
-    x2d = jnp.asarray(rng.normal(0, 1, (2, chunk)), jnp.float32)
-    ts = jnp.asarray([3.3, 3.4], jnp.float32)   # lambda ~0.25/column
+    x_np = rng.normal(0, 0.5, (2, chunk)).astype(np.float32)  # below t
+    for c in range(2):
+        hot = _distinct_cell_indices(chunk, 40, 0.001)
+        x_np[c, hot] = (rng.uniform(4.0, 8.0, 40)
+                        * rng.choice([-1, 1], 40))
+    x2d = jnp.asarray(x_np)
+    ts = jnp.asarray([3.3, 3.4], jnp.float32)
     vals, idxs, counts = fused_select_candidates_chunked(x2d, ts,
                                                          density=0.001)
     assert vals.shape == (2, nc)
@@ -199,13 +227,17 @@ def test_batched_fused_warm_selection_and_ef():
 
     n_chunks, chunk, k = 2, 60_000, 600
     rng = np.random.default_rng(11)
-    x = jnp.asarray(rng.normal(0, 1, (n_chunks, chunk)), jnp.float32)
-    # warm states inside the count band (count ~180 >= k/4 = 150) but with
-    # per-column lambda = R*P(|x|>t) ~0.76 so the S-slot candidate-cap
-    # overflow probability is ~1e-7 — the fused and warm paths then select
-    # the IDENTICAL set (overflow legitimately defers entries to the
-    # residual and is covered by test_column_overflow_defers_to_residual)
-    state = jnp.asarray([2.97, 3.0], jnp.float32)
+    # above-threshold entries in pairwise-distinct cells (the one-slot cap
+    # cannot fire — overflow deferral is covered by
+    # test_cell_overflow_defers_to_residual), count ~400 inside the warm
+    # band [k/4, 4k]: fused and warm then select the IDENTICAL set
+    x_np = rng.normal(0, 0.3, (n_chunks, chunk)).astype(np.float32)
+    for c in range(n_chunks):
+        hot = _distinct_cell_indices(chunk, 400, 0.01)
+        x_np[c, hot] = (rng.uniform(3.0, 8.0, 400)
+                        * rng.choice([-1, 1], 400))
+    x = jnp.asarray(x_np)
+    state = jnp.asarray([2.0, 2.1], jnp.float32)
     res, t_new = gaussian_fused_compress_batched(x, k, state,
                                                  density=0.01)
     ref, t_ref = gaussian_warm_compress_batched(x, k, state, density=0.01)
@@ -226,8 +258,10 @@ def test_batched_fused_warm_selection_and_ef():
 
 
 def test_batched_fused_cold_lane_recovery():
-    """One cold lane (state 0) must recover via bisection WITHOUT
-    disturbing the warm lane's carried threshold trajectory."""
+    """One cold lane (state 0) must bootstrap its threshold from its own
+    k-th candidate magnitude (_controller_update — the branch-free r5
+    design has no bisection/recovery path) WITHOUT disturbing the warm
+    lane's carried threshold trajectory."""
     n_chunks, chunk, k = 2, 60_000, 600
     rng = np.random.default_rng(13)
     x = jnp.asarray(rng.normal(0, 1, (n_chunks, chunk)), jnp.float32)
