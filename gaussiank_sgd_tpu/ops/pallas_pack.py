@@ -148,22 +148,26 @@ def _select_kernel(x_ref, t_ref, val_ref, idx_ref, count_ref, *,
     ``compress_buckets`` (the caller offsets per chunk).
 
     x_ref: [R, 128] f32 block of this chunk's buffer view.
-    t_ref: [1, 1] f32 — THIS chunk's threshold in SMEM.
+    t_ref: [n_chunks, 1] f32 — ALL thresholds in SMEM (whole-array block:
+    Mosaic requires SMEM block shapes to equal the array dims; the kernel
+    picks its chunk's row by ``program_id(0)``).
     val_ref/idx_ref: [R//seg, 128] candidate tiles for this block.
-    count_ref: [1, 1] i32 SMEM accumulator (exact above-threshold count),
-    one slot per chunk, carried across the chunk's sequential blocks.
+    count_ref: [n_chunks, 1] i32 SMEM accumulator (exact above-threshold
+    count), one row per chunk, carried across the chunk's sequential
+    blocks.
     """
+    c = pl.program_id(0)
     i = pl.program_id(1)
 
     @pl.when(i == 0)
     def _init():
-        count_ref[0, 0] = 0
+        count_ref[c, 0] = 0
 
     x = x_ref[:]
     ax = jnp.abs(x)
-    t = t_ref[0, 0]
+    t = t_ref[c, 0]
     mask = ax > t
-    count_ref[0, 0] += jnp.sum(mask.astype(jnp.int32))
+    count_ref[c, 0] += jnp.sum(mask.astype(jnp.int32))
 
     nseg = rows // seg
     seg_mask = seg - 1
@@ -226,14 +230,19 @@ def fused_select_candidates_chunked(
         in_specs=[
             pl.BlockSpec((R, _LANES), lambda c, i: (c * bpc + i, 0),
                          memory_space=space),
-            pl.BlockSpec((1, 1), lambda c, i: (c, 0), memory_space=smem),
+            # whole-array SMEM blocks (Mosaic: block dims must equal the
+            # array dims for non-(8,128)-divisible shapes); the kernel
+            # indexes its chunk's row by program_id(0)
+            pl.BlockSpec((n_chunks, 1), lambda c, i: (0, 0),
+                         memory_space=smem),
         ],
         out_specs=(
             pl.BlockSpec((nseg, _LANES), lambda c, i: (c * bpc + i, 0),
                          memory_space=space),
             pl.BlockSpec((nseg, _LANES), lambda c, i: (c * bpc + i, 0),
                          memory_space=space),
-            pl.BlockSpec((1, 1), lambda c, i: (c, 0), memory_space=smem),
+            pl.BlockSpec((n_chunks, 1), lambda c, i: (0, 0),
+                         memory_space=smem),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((n_chunks * bpc * nseg, _LANES),
@@ -343,8 +352,8 @@ def fused_select_pack(acc: jax.Array, k: int, threshold: jax.Array,
     vals, idxs, count = fused_select_candidates(acc, threshold, density,
                                                 interpret)
     nc = vals.shape[0]
-    if k > nc:  # geometry guarantees nc >= k at supported densities (with
-        # margin below the density = S/R capacity ceiling, where nc == k);
+    if k > nc:  # geometry guarantees nc >= k at supported densities
+        # (nc = n/SEG >= 2k everywhere below the 1/32 ceiling);
         # unreachable for k = ceil(density*n), but fail loud for direct calls
         raise ValueError(f"k={k} exceeds candidate capacity {nc} "
                          f"(n={n}, density={density})")
@@ -384,7 +393,6 @@ def gaussian_fused_compress(acc: jax.Array, k: int, state: jax.Array,
         buffer; count >> k defers overflow to the residual). Exactness of
         EF bookkeeping never depends on the threshold's quality.
     """
-    from ..compressors.base import finish_pack
     from ..compressors.gaussian import gaussian_warm_compress
 
     n = acc.shape[0]
@@ -406,8 +414,13 @@ def gaussian_fused_compress(acc: jax.Array, k: int, state: jax.Array,
                                                 interpret)
     sent_idx, val = _select_candidates_topk(vals, idxs, k, n)
     comp, residual = finish_pack(acc, sent_idx, val.astype(acc.dtype))
-    t_new = _controller_update(state, count, val, sent_idx < n, k, gain)
-    return CompressResult(comp, residual, count), t_new
+    valid = sent_idx < n
+    t_new = _controller_update(state, count, val, valid, k, gain)
+    # cold bootstrap (t <= 0) masks ~everything: report what was actually
+    # selected instead of nnz(acc), so the logged selection count
+    # (observability parity, base.py) keeps its ~k scale on that one step
+    nsel = jnp.where(state > 0, count, jnp.sum(valid.astype(jnp.int32)))
+    return CompressResult(comp, residual, nsel), t_new
 
 
 def gaussian_fused_compress_batched(
@@ -429,7 +442,6 @@ def gaussian_fused_compress_batched(
     coupling — a persistently-cold lane can never drag warm lanes into a
     recovery path, because no recovery path exists.
     """
-    from ..compressors.base import finish_pack
     from ..compressors.gaussian import gaussian_warm_compress_batched
 
     n_chunks, chunk = x.shape
@@ -454,6 +466,9 @@ def gaussian_fused_compress_batched(
         lambda vc, ic: _select_candidates_topk(vc, ic, k, chunk))(vals, idxs)
     val = val.astype(x.dtype)
     comp, residual = jax.vmap(finish_pack)(x, sent_idx, val)
-    t_new = _controller_update(state, counts, val, sent_idx < chunk, k,
-                               gain)
-    return CompressResult(comp, residual, counts), t_new
+    valid = sent_idx < chunk
+    t_new = _controller_update(state, counts, val, valid, k, gain)
+    # per-lane cold-bootstrap count fix — see gaussian_fused_compress
+    nsel = jnp.where(state > 0, counts,
+                     jnp.sum(valid.astype(jnp.int32), axis=-1))
+    return CompressResult(comp, residual, nsel), t_new

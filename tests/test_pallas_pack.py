@@ -62,10 +62,10 @@ def _distinct_cell_indices(n, count, density):
     out = []
     base = 0
     while len(out) < count:
+        assert base < n, "n too small for distinct-cell layout"
         take = min(_LANES, count - len(out))
         out.extend(range(base, base + take))
         base += seg * _LANES                   # next segment
-        assert base < n, "n too small for distinct-cell layout"
     return np.asarray(out[:count])
 
 
