@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-5 AN4 arm that BITES (VERDICT r4 item 6): the r4 protocol left both
+# arms at CER 0.95 (CTC blank phase). Protocol re-tuned by probe
+# (convergence_parity_an4probe.json): effective lr 0.1 (0.0125 x 8
+# workers), 6-label alphabet (wider per-label frequency bands), time 32,
+# tgt_len 2, hidden 64 — dense CER reaches 0.023 by 2000 steps and loss
+# ~0.06 by step 400, so 1000 steps suffices for the paired arms.
+set -x
+cd /root/repo
+python analysis/convergence_parity.py --dnn lstman4 --dataset an4 \
+  --arms none,gaussian --steps 1000 --batch-size 2 --lr 0.0125 \
+  --density 0.01 --devices 8 --seeds 2 \
+  --model-kwargs '{"hidden": 64, "num_layers": 1}' \
+  --dataset-kwargs '{"tgt_len": 2, "synthetic_examples": 256, "time": 32, "num_labels": 6}' \
+  --compress-warmup-steps 30 --tag an4 --outdir /tmp/gksgd_parity_an4_r5

@@ -186,6 +186,7 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
     from .compressors import get_compressor
     from .models import get_model
     from .parallel.bucketing import plan_for_params
+    from .parallel.flat_opt import FlatSGDM
     from .parallel.mesh import data_parallel_mesh, shard_batch
     from .parallel.trainstep import build_dp_train_step
     from .training.losses import make_loss_fn
@@ -211,8 +212,11 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
         comp = probes.get(name) or get_compressor(name, density=density)
         ts = build_dp_train_step(
             make_loss_fn(spec, recurrent=recurrent),
-            optax.sgd(0.1, momentum=0.9), comp, plan, mesh,
-            recurrent=recurrent)
+            None, comp, plan, mesh,
+            recurrent=recurrent,
+            # the flat sparse-aware update (parallel/flat_opt.py) — the
+            # framework's production SGD path, so the bench times it
+            flat_opt=FlatSGDM(lr=0.1, momentum=0.9))
 
         def mk(ts=ts):
             return ts.init_state(params, jax.random.PRNGKey(2),

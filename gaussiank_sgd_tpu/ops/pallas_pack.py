@@ -276,15 +276,16 @@ def fused_select_candidates(
     return vals[0], idxs[0], counts[0]
 
 
-_EXACT_CAND_MAX = 1 << 19
+_EXACT_CAND_MAX = 1 << 17
 
 
 def _cand_top_k(vals: jax.Array, k: int):
     """Top-k over the candidate magnitudes: exact ``lax.top_k`` while the
-    buffer is small (sort-based top_k is TPU-slow — measured ~1.1 ms at
-    890k candidates vs ~0.8 ms approx), ``approx_max_k`` (recall 0.95)
-    beyond — the ~5% it misses at the k-boundary stays in the EF residual
-    and is re-selected next step."""
+    buffer is small, ``approx_max_k`` (recall 0.95) beyond — sort-based
+    top_k is TPU-slow (measured ~1.1 ms at 890k candidates vs ~0.8 ms
+    approx; the 128k ceiling also routes the 15-25M CNN configs' 234-391k
+    buffers to the approx path). The ~5% approx misses at the k-boundary
+    stay in the EF residual and are re-selected next step."""
     key = jnp.abs(vals)
     if vals.shape[0] <= _EXACT_CAND_MAX:
         return lax.top_k(key, k)

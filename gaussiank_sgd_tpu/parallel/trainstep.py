@@ -40,6 +40,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..compressors.base import CompressedGrad, decompress
 from ..compressors.registry import CompressorSpec
 from .bucketing import BucketPlan
+from .flat_opt import FlatSGDM
 
 
 class TrainState(NamedTuple):
@@ -296,7 +297,7 @@ class DPTrainStep(NamedTuple):
 
 def build_dp_train_step(
     loss_fn: LossFn,
-    optimizer: optax.GradientTransformation,
+    optimizer: Optional[optax.GradientTransformation],
     spec: CompressorSpec,
     plan: BucketPlan,
     mesh: Mesh,
@@ -308,6 +309,7 @@ def build_dp_train_step(
     exchange: str = "allgather",
     recurrent: bool = False,
     sp_axis: Optional[str] = None,
+    flat_opt: Optional[FlatSGDM] = None,
 ) -> DPTrainStep:
     """Build the data-parallel train step over ``mesh``.
 
@@ -354,6 +356,20 @@ def build_dp_train_step(
         raise ValueError(f"unknown exchange {exchange!r}")
     gather_axis = axes[-1]          # ICI axis on hierarchical meshes
     outer_axes = axes[:-1]          # DCN axes (empty on 1-D meshes)
+    if flat_opt is not None:
+        # the flat sparse-aware update needs the pairs to be the ONLY
+        # gradient carrier: DCN outer axes psum a dense partial and
+        # fold_lr rescales the accumulator — both take the optax path.
+        # ValueError, not assert: silently-wrong training under -O
+        # (repo convention, code-review r4/r5)
+        if outer_axes or fold_lr is not None:
+            raise ValueError(
+                "flat_opt supports 1-D meshes without fold_lr; use the "
+                "optax path otherwise")
+        if optimizer is not None:
+            raise ValueError(
+                "pass optimizer=None with flat_opt — one optimizer "
+                "config, no silent shadowing")
     n_total = plan.total_numel
 
     def _all_axes_size():
@@ -415,6 +431,23 @@ def build_dp_train_step(
                           state.comp_state if new_comp_state is None
                           else new_comp_state)
 
+    def _flat_params_if_wd(state: TrainState):
+        if flat_opt.weight_decay:
+            return ravel_pytree(state.params)[0]
+        return None
+
+    def _apply_flat(state: TrainState, mstate: Any, upd_flat: jax.Array,
+                    m_new: jax.Array, unravel, new_residual: jax.Array,
+                    new_carry: Any, new_comp_state: Any = None):
+        """Flat sparse-aware optimizer commit (parallel/flat_opt.py): the
+        momentum buffer was updated by the caller (sparse scatter or dense
+        add); apply the flat update through the unravel views."""
+        params = optax.apply_updates(state.params, unravel(upd_flat))
+        return TrainState(state.step + 1, params, mstate, {"m": m_new},
+                          new_residual, state.rng, new_carry,
+                          state.comp_state if new_comp_state is None
+                          else new_comp_state)
+
     def sparse_step_fn(state: TrainState, batch: Any):
         data_rng, comp_rng = _step_rngs(state)
         loss, mstate, aux, new_carry, flat_g, unravel = _local_grads(
@@ -438,7 +471,8 @@ def build_dp_train_step(
             # the /P average rides the k-sized VALUES, not the n-sized
             # dense buffer: one full read+write pass saved (r4 floor work)
             gcomp = gcomp._replace(values=gcomp.values / _all_axes_size())
-            dense = decompress(gcomp, n_total, grad_dtype)
+            if flat_opt is None:
+                dense = decompress(gcomp, n_total, grad_dtype)
             residual = global_residual(acc, gcomp)
             bytes_sent = jnp.float32(n_bytes)
         else:
@@ -452,16 +486,29 @@ def build_dp_train_step(
             g_idx = lax.all_gather(comp.indices, gather_axis, tiled=True)
             g_val = lax.all_gather(comp.values, gather_axis,
                                    tiled=True) / _all_axes_size()
-            dense = decompress(CompressedGrad(g_idx, g_val), n_total,
-                               grad_dtype)
-            for a in outer_axes:
-                dense = lax.psum(dense, a)
+            if flat_opt is None:
+                dense = decompress(CompressedGrad(g_idx, g_val), n_total,
+                                   grad_dtype)
+                for a in outer_axes:
+                    dense = lax.psum(dense, a)
             bytes_sent = jnp.float32(
                 k_packed * (4 + comp.values.dtype.itemsize))
 
-        new_state = _apply(state, mstate, dense, unravel, residual,
-                           new_carry,
-                           cstate[None, :] if spec.stateful else ())
+        if flat_opt is not None:
+            # scatter the gathered pairs straight into the decayed momentum
+            # (flat_opt.py): no dense gradient buffer exists on this path
+            if exchange == "gtopk":
+                g_idx, g_val = gcomp.indices, gcomp.values
+            upd, m_new = flat_opt.sparse_step(
+                state.opt_state["m"], g_idx.reshape(-1), g_val,
+                _flat_params_if_wd(state), state.step)
+            new_state = _apply_flat(state, mstate, upd, m_new, unravel,
+                                    residual, new_carry,
+                                    cstate[None, :] if spec.stateful else ())
+        else:
+            new_state = _apply(state, mstate, dense, unravel, residual,
+                               new_carry,
+                               cstate[None, :] if spec.stateful else ())
         return new_state, StepMetrics(
             loss, aux, _pmean(jnp.linalg.norm(flat_g)),
             _pmean(nsel.astype(jnp.float32)), bytes_sent)
@@ -477,8 +524,15 @@ def build_dp_train_step(
         dense = dense / _all_axes_size()
         # Warm-up is compression-off: the EF residual is untouched (and zero
         # if warm-up precedes any sparse step), matching SURVEY.md §2.3.
-        new_state = _apply(state, mstate, dense, unravel, state.ef_residual,
-                           new_carry)
+        if flat_opt is not None:
+            upd, m_new = flat_opt.dense_step(
+                state.opt_state["m"], dense, _flat_params_if_wd(state),
+                state.step)
+            new_state = _apply_flat(state, mstate, upd, m_new, unravel,
+                                    state.ef_residual, new_carry)
+        else:
+            new_state = _apply(state, mstate, dense, unravel,
+                               state.ef_residual, new_carry)
         return new_state, StepMetrics(
             loss, aux, _pmean(jnp.linalg.norm(flat_g)),
             jnp.float32(n_total), jnp.float32(n_total * 4))
@@ -581,7 +635,8 @@ def build_dp_train_step(
             step=jnp.int32(0),
             params=params,
             model_state=model_state,
-            opt_state=optimizer.init(params),
+            opt_state=(flat_opt.init(n_total, grad_dtype)
+                       if flat_opt is not None else optimizer.init(params)),
             ef_residual=jnp.zeros((mesh.size * n_total,), grad_dtype),
             rng=rng,
             carry=jax.tree.map(jnp.copy, carry),

@@ -130,6 +130,58 @@ def restore_checkpoint(path: str, target: TrainState,
             f"ef_residual ({target.ef_residual.size}) — different model?")
     carry_leaves = jax.tree_util.tree_leaves(target.carry)
 
+    # --- optimizer-format compatibility (r5) -------------------------------
+    # The flat sparse-aware optimizer (parallel/flat_opt.py) stores
+    # opt_state as {"m": flat}; checkpoints written by the optax path store
+    # the optax chain's tree. Restoring a legacy checkpoint into a
+    # flat-opt run: restore the legacy structure (from the checkpoint's own
+    # metadata), then RAVEL its momentum trace into the flat buffer — the
+    # trace mirrors the params tree, so ravel order == the flat index
+    # space and momentum carries over exactly. No trace (momentum-less
+    # legacy run) -> fresh zeros.
+    tgt_opt = target.opt_state
+    flat_target = isinstance(tgt_opt, dict) and set(tgt_opt) == {"m"}
+    meta_opt = meta["opt_state"]
+    legacy_opt = (flat_target and not (
+        isinstance(meta_opt, dict) and set(meta_opt) == {"m"}))
+
+    def _opt_abstract(sharding=None):
+        if legacy_opt:
+            return jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(tuple(m.shape),
+                                               m.dtype, sharding=sharding),
+                meta_opt)
+        return jax.tree.map(lambda x: sds(x, sharding), tgt_opt)
+
+    def _convert_opt(restored_opt):
+        if not legacy_opt:
+            return restored_opt
+        def find_trace(node):
+            if isinstance(node, dict):
+                if "trace" in node:
+                    return node["trace"]
+                for v in node.values():
+                    r = find_trace(v)
+                    if r is not None:
+                        return r
+            elif isinstance(node, (list, tuple)):
+                for v in node:
+                    r = find_trace(v)
+                    if r is not None:
+                        return r
+            return None
+        trace = find_trace(restored_opt)
+        tm = tgt_opt["m"]
+        if trace is None:
+            return {"m": jnp.zeros(tm.shape, tm.dtype)}
+        from jax.flatten_util import ravel_pytree
+        flat, _ = ravel_pytree(trace)
+        if flat.size != tm.size:       # different model/opt layout: fail loud
+            raise ValueError(
+                f"legacy opt_state trace has {flat.size} params, live model "
+                f"has {tm.size}")
+        return {"m": flat.astype(tm.dtype)}
+
     def _old_shape_carry(sharding=None):
         """Abstract carry at the CHECKPOINT's shapes (its leading dim is the
         old global batch = per-worker batch x old P, which cannot map onto
@@ -164,7 +216,7 @@ def restore_checkpoint(path: str, target: TrainState,
             params=jax.tree.map(lambda x: sds(x, repl), target.params),
             model_state=jax.tree.map(lambda x: sds(x, repl),
                                      target.model_state),
-            opt_state=jax.tree.map(lambda x: sds(x, repl), target.opt_state),
+            opt_state=_opt_abstract(repl),
             ef_residual=ef_abstract,
             rng=sds(target.rng, repl),
             carry=carry_abstract,
@@ -173,7 +225,8 @@ def restore_checkpoint(path: str, target: TrainState,
     else:
         abstract = jax.tree.map(sds, target)
         abstract = abstract._replace(
-            ef_residual=jax.ShapeDtypeStruct((old_p, n_flat), ef_dtype))
+            ef_residual=jax.ShapeDtypeStruct((old_p, n_flat), ef_dtype),
+            opt_state=_opt_abstract())
         if old_p != new_p:
             abstract = abstract._replace(
                 carry=_old_shape_carry(),
@@ -184,6 +237,9 @@ def restore_checkpoint(path: str, target: TrainState,
     restored = ckptr.restore(path, abstract)
     if not isinstance(restored, TrainState):
         restored = TrainState(*restored)
+    if legacy_opt:
+        restored = restored._replace(
+            opt_state=_convert_opt(restored.opt_state))
     if old_p == new_p:
         # [P, N] disk layout -> live flat [P*N]; with a mesh the reshape
         # is shard-local (dim-0 contiguous blocks stay put)

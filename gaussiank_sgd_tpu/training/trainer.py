@@ -154,6 +154,17 @@ class Trainer:
         chain.append(optax.sgd(lr_for_opt, momentum=cfg.momentum or None,
                                nesterov=cfg.nesterov))
         optimizer = optax.chain(*chain)
+        # the flat sparse-aware update (parallel/flat_opt.py) covers the
+        # torch-SGD-equivalent chain exactly (wd-before-momentum, schedule
+        # on the lr) on 1-D meshes; nesterov/fold-lr/hierarchical fall
+        # back to the optax path
+        from ..parallel.flat_opt import FlatSGDM
+        flat_opt = None
+        if (not cfg.nesterov and not cfg.fold_lr
+                and len(self.mesh.axis_names) == 1):
+            flat_opt = FlatSGDM(lr=self.schedule,
+                                momentum=cfg.momentum or 0.0,
+                                weight_decay=cfg.weight_decay or 0.0)
 
         # ---- compression + the fused step ----
         # LSTM bptt carry across windows (the reference's "repackaging",
@@ -174,7 +185,8 @@ class Trainer:
         self.ts = build_dp_train_step(
             make_loss_fn(self.spec, cfg.label_smoothing,
                          recurrent=self.recurrent,
-                         input_norm=input_norm), optimizer, comp,
+                         input_norm=input_norm),
+            None if flat_opt is not None else optimizer, comp,
             plan, self.mesh,
             num_microbatches=cfg.nsteps_update,
             clip_norm=cfg.clip_norm,
@@ -182,6 +194,7 @@ class Trainer:
             recurrent=self.recurrent,
             exchange=cfg.exchange,
             sp_axis="sp" if self.sp else None,
+            flat_opt=flat_opt,
         )
         carry = (self.spec.module.initial_carry(local_bs)
                  if self.recurrent else ())

@@ -149,3 +149,50 @@ def test_restore_same_mesh_keeps_rows(tmp_path):
     ts2, s2, _ = _problem(8)
     restored = restore_checkpoint(path, s2, ts2.mesh)
     np.testing.assert_array_equal(np.asarray(restored.ef_residual), ef)
+
+
+def test_legacy_optax_checkpoint_restores_into_flat_opt(tmp_path):
+    """A checkpoint written by the optax path must restore into a
+    flat-opt run (r5 optimizer-format change): the optax momentum trace
+    ravels into the flat buffer — momentum carries over, params match."""
+    from jax.flatten_util import ravel_pytree
+
+    from gaussiank_sgd_tpu.parallel.flat_opt import FlatSGDM
+
+    ts8, s8, b8 = _problem(8)                     # optax.sgd path
+    s8, _ = ts8.sparse_step(s8, b8)
+    path = save_checkpoint(str(tmp_path / "ck"), s8)
+
+    # a flat-opt twin of the same problem
+    import flax.linen as nn
+
+    class M(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            return nn.Dense(4)(nn.relu(nn.Dense(16)(x)))
+
+    m = M()
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 8))
+    v = m.init({"params": jax.random.PRNGKey(0)}, x)
+
+    def loss_fn(params, mstate, b, rng):
+        logits = m.apply({"params": params}, b[0])
+        return (optax.softmax_cross_entropy_with_integer_labels(
+            logits, b[1]).mean(), (mstate, {}))
+
+    mesh = data_parallel_mesh(8)
+    comp = get_compressor("gaussian", density=0.1)
+    plan = plan_for_params(v["params"], 0.1)
+    ts_f = build_dp_train_step(loss_fn, None, comp, plan, mesh,
+                               flat_opt=FlatSGDM(lr=0.1))
+    s_f = ts_f.init_state(v["params"], jax.random.PRNGKey(2))
+    restored = restore_checkpoint(path, s_f, ts_f.mesh)
+
+    # params restore exactly; the legacy momentum trace (sgd(0.1) has no
+    # momentum -> no trace) re-initializes to zeros without raising
+    for a, b in zip(jax.tree_util.tree_leaves(restored.params),
+                    jax.tree_util.tree_leaves(s8.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    assert set(restored.opt_state) == {"m"}
+    assert restored.opt_state["m"].size == \
+        ravel_pytree(s8.params)[0].size

@@ -231,3 +231,69 @@ def test_metrics_fields():
     assert m.bytes_sent.dtype == jnp.float32  # f32: no int32 wrap at scale
     assert int(m.bytes_sent) == ts.plan.total_k * 8
     assert int(m.num_selected) >= 0
+
+
+def test_flat_opt_matches_optax_trajectory():
+    """The flat sparse-aware SGD+momentum update (parallel/flat_opt.py)
+    must produce the SAME parameter trajectory as the optax path — sparse
+    steps, dense warm-up steps, and a dense->sparse transition — for both
+    plain momentum and momentum+weight-decay."""
+    from gaussiank_sgd_tpu.parallel.flat_opt import FlatSGDM
+
+    for wd in (0.0, 0.01):
+        params, loss_fn, make_batch = make_problem()
+        mesh = data_parallel_mesh()
+        spec = get_compressor("topk", density=0.25)
+        plan = plan_for_params(params, 0.25, None)
+        chain = []
+        if wd:
+            chain.append(optax.add_decayed_weights(wd))
+        chain.append(optax.sgd(0.05, momentum=0.9))
+        ts_ref = build_dp_train_step(loss_fn, optax.chain(*chain), spec,
+                                     plan, mesh)
+        ts_flat = build_dp_train_step(
+            loss_fn, None, spec, plan, mesh,
+            flat_opt=FlatSGDM(lr=0.05, momentum=0.9, weight_decay=wd))
+        s_ref = ts_ref.init_state(params, jax.random.PRNGKey(42))
+        s_flat = ts_flat.init_state(params, jax.random.PRNGKey(42))
+        batch = shard_batch(mesh, make_batch(64))
+        for i in range(3):                       # dense warm-up
+            s_ref, _ = ts_ref.dense_step(s_ref, batch)
+            s_flat, _ = ts_flat.dense_step(s_flat, batch)
+        for i in range(5):                       # sparse (EF + momentum)
+            s_ref, m_ref = ts_ref.sparse_step(s_ref, batch)
+            s_flat, m_flat = ts_flat.sparse_step(s_flat, batch)
+        for kname in params:
+            np.testing.assert_allclose(
+                np.asarray(s_flat.params[kname]),
+                np.asarray(s_ref.params[kname]), rtol=1e-5, atol=1e-6,
+                err_msg=f"wd={wd} param {kname}")
+        np.testing.assert_allclose(float(m_flat.loss), float(m_ref.loss),
+                                   rtol=1e-5)
+
+
+def test_flat_opt_matches_optax_gtopk():
+    """Same trajectory equivalence over the gTop-k butterfly exchange —
+    the fused path rebinds (idx, val) to the globally-selected,
+    /P-pre-averaged pairs (trainstep gtopk branch)."""
+    from gaussiank_sgd_tpu.parallel.flat_opt import FlatSGDM
+
+    params, loss_fn, make_batch = make_problem()
+    mesh = data_parallel_mesh()
+    spec = get_compressor("topk", density=0.25)
+    plan = plan_for_params(params, 0.25, None)
+    ts_ref = build_dp_train_step(loss_fn, optax.sgd(0.05, momentum=0.9),
+                                 spec, plan, mesh, exchange="gtopk")
+    ts_flat = build_dp_train_step(
+        loss_fn, None, spec, plan, mesh, exchange="gtopk",
+        flat_opt=FlatSGDM(lr=0.05, momentum=0.9))
+    s_ref = ts_ref.init_state(params, jax.random.PRNGKey(42))
+    s_flat = ts_flat.init_state(params, jax.random.PRNGKey(42))
+    batch = shard_batch(mesh, make_batch(64))
+    for _ in range(4):
+        s_ref, m_ref = ts_ref.sparse_step(s_ref, batch)
+        s_flat, m_flat = ts_flat.sparse_step(s_flat, batch)
+    for kname in params:
+        np.testing.assert_allclose(np.asarray(s_flat.params[kname]),
+                                   np.asarray(s_ref.params[kname]),
+                                   rtol=1e-5, atol=1e-6)
