@@ -27,7 +27,8 @@ ARTIFACTS = os.path.join(REPO, "analysis", "artifacts")
 # earlier ones at the same (config, density, compressor) key so the curve
 # always reflects the freshest measurement of each point
 SOURCES = ("bench_matrix_hidens.json", "bench_matrix_hidens_c5.json",
-           "bench_matrix_r4.json", "bench_matrix_r4c5.json")
+           "bench_matrix_r4.json", "bench_matrix_r4c5.json",
+           "bench_matrix_r5.json")
 
 
 def main():
