@@ -1,0 +1,37 @@
+"""Version-compat shims for the jax API surface this repo targets.
+
+The codebase is written against the current jax surface (``jax.shard_map``
+with ``check_vma=``); older installs (<0.5) ship the same functionality as
+``jax.experimental.shard_map.shard_map`` with the ``check_rep=`` spelling.
+One shim here keeps every call site on the modern spelling — the repo
+convention is that ALL version probing lives in this module (and
+``virtual_cpu.provision``), never inline at use sites.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:  # pragma: no cover - exercised only on old jax
+    def axis_size(axis_name: Any) -> Any:
+        """Mesh-axis size inside shard_map — static on every jax version
+        (the psum of a trace-time 1 constant-folds to the axis size)."""
+        return jax.lax.psum(1, axis_name)
+
+
+if hasattr(jax, "shard_map"):
+    def shard_map(f: Callable[..., Any], *, mesh: Any, in_specs: Any,
+                  out_specs: Any, check_vma: bool = True) -> Callable[..., Any]:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+else:  # pragma: no cover - exercised only on old jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f: Callable[..., Any], *, mesh: Any, in_specs: Any,
+                  out_specs: Any, check_vma: bool = True) -> Callable[..., Any]:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)

@@ -28,6 +28,7 @@ import math
 from typing import Callable, NamedTuple, Optional
 
 import jax
+from jax.typing import DTypeLike
 import jax.numpy as jnp
 
 
@@ -107,7 +108,8 @@ def pack_by_mask(acc: jax.Array, mask: jax.Array, k: int,
 
 
 def select_by_mask(acc: jax.Array, mask: jax.Array, k: int,
-                   priority: str = "index"):
+                   priority: str = "index",
+                   ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """The selection half of :func:`pack_by_mask`: ``(sent_idx [k], val
     [k], num_selected)`` with the out-of-range sentinel ``n`` marking
     invalid slots. Split out so stateful compressors can route ONLY these
@@ -133,7 +135,8 @@ def select_by_mask(acc: jax.Array, mask: jax.Array, k: int,
     return sent_idx, val, num_selected
 
 
-def finish_pack(acc: jax.Array, sent_idx: jax.Array, val: jax.Array):
+def finish_pack(acc: jax.Array, sent_idx: jax.Array, val: jax.Array,
+                ) -> tuple[CompressedGrad, jax.Array]:
     """(CompressedGrad, residual) from a sentinel-marked selection: zero
     exactly the sent entries (invalid slots scatter out-of-range and
     drop); packed indices map the sentinel back to 0."""
@@ -150,7 +153,7 @@ def pack_by_threshold(acc: jax.Array, threshold: jax.Array, k: int) -> CompressR
 
 
 def decompress(compressed: CompressedGrad, numel: int,
-               dtype=jnp.float32) -> jax.Array:
+               dtype: DTypeLike = jnp.float32) -> jax.Array:
     """Scatter a packed sparse gradient back to a dense flat buffer.
 
     Padding slots (index 0, value 0) add zero, so they are no-ops. When the

@@ -11,6 +11,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.typing import DTypeLike
 
 from .base import CompressedGrad, CompressResult
 
@@ -47,7 +48,8 @@ def topk_compress(acc: jax.Array, k: int,
 def approx_topk_compress(acc: jax.Array, k: int,
                          rng: Optional[jax.Array] = None,
                          *, recall_target: float = 0.95,
-                         select_dtype=None) -> CompressResult:
+                         select_dtype: Optional[DTypeLike] = None,
+                         ) -> CompressResult:
     """Top-k via the TPU-native two-level select (``lax.approx_max_k``).
 
     The TPU-first answer to the reference's "exact top-k is too expensive on

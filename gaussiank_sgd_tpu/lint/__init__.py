@@ -1,0 +1,22 @@
+"""gklint — JAX-aware static analysis for the TPU training stack.
+
+Six rules enforcing the repo's jit/donation/collective invariants (see
+docs/LINTING.md): host-sync-in-hot-path, recompile-hazard,
+mesh-axis-consistency, donation-check, traced-control-flow, fail-loud.
+
+CLI: ``python -m gaussiank_sgd_tpu.lint [--json] [paths...]`` — exits
+nonzero on findings not in the committed baseline. Library entry points:
+
+    from gaussiank_sgd_tpu.lint import lint_source, lint_paths
+"""
+
+from .baseline import (default_baseline_path, load_baseline, split_new,
+                       write_baseline)
+from .core import Finding, lint_paths, lint_source
+from .rules import ALL_RULES, RULES_BY_NAME, select_rules
+
+__all__ = [
+    "ALL_RULES", "Finding", "RULES_BY_NAME", "default_baseline_path",
+    "lint_paths", "lint_source", "load_baseline", "select_rules",
+    "split_new", "write_baseline",
+]

@@ -1,0 +1,103 @@
+"""gklint CLI.
+
+    python -m gaussiank_sgd_tpu.lint                  # lint the package
+    python -m gaussiank_sgd_tpu.lint --json           # machine output
+    python -m gaussiank_sgd_tpu.lint --write-baseline # accept current set
+    python -m gaussiank_sgd_tpu.lint --list-rules
+    python -m gaussiank_sgd_tpu.lint path/to/file.py another/dir
+
+Exit codes: 0 clean (or all findings baselined), 1 new findings, 2 usage
+error. Pure-AST: runs without initializing jax/TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .baseline import (default_baseline_path, load_baseline, split_new,
+                       write_baseline)
+from .core import Finding, lint_paths
+from .rules import ALL_RULES, select_rules
+
+
+def _default_paths() -> List[str]:
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gaussiank_sgd_tpu.lint",
+        description="JAX-aware static analysis for the TPU training stack")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="JSON output")
+    ap.add_argument("--rules", help="comma-separated subset of rules to run")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: <repo>/"
+                         ".gklint-baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every finding gates")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept the current findings as the new baseline")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.name:26s} [{r.severity}] {r.description}")
+        return 0
+
+    try:
+        rules = select_rules(args.rules.split(",") if args.rules else None)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    paths = args.paths or _default_paths()
+    # findings are repo-root-relative when linting the installed package so
+    # the committed baseline matches from any cwd
+    pkg_parent = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    findings = lint_paths(paths, rules=rules,
+                          rel_to=pkg_parent if not args.paths else None)
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"gklint: wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    new, old = split_new(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "tool": "gklint",
+            "checked_paths": paths,
+            "baseline": None if args.no_baseline else baseline_path,
+            "counts": {"total": len(findings), "new": len(new),
+                       "baselined": len(old)},
+            "new_findings": [f.to_json() for f in new],
+            "baselined_findings": [f.to_json() for f in old],
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.human())
+        summary = (f"gklint: {len(new)} new finding(s), "
+                   f"{len(old)} baselined, "
+                   f"{len(ALL_RULES) if not args.rules else len(rules)} "
+                   f"rule(s)")
+        print(summary)
+        if new:
+            print("  fix, suppress with `# gklint: disable=<rule>`, or "
+                  "accept via --write-baseline (docs/LINTING.md)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,214 @@
+"""gklint engine — findings, suppressions, module context, file walking.
+
+The linter is pure-AST (``ast`` + ``tokenize``): it never imports the code
+it checks, so it runs in CI without jax/TPU initialization and in O(ms) per
+file. Rules live in ``lint/rules``; each is a small object with a ``name``,
+a ``severity``, and a ``check(ctx)`` generator over :class:`Finding`.
+
+Suppression syntax (documented in docs/LINTING.md):
+
+  * trailing:      ``x.item()  # gklint: disable=host-sync-in-hot-path``
+  * standalone (applies to the NEXT line)::
+
+        # gklint: disable=fail-loud
+        assert invariant, "..."
+
+  * whole file:    ``# gklint: disable-file=<rule>[,<rule>...]``
+
+``disable=all`` (or ``*``) suppresses every rule at that site.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set
+
+from .reachability import JitReachability
+
+SEVERITIES = ("error", "warning")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*gklint:\s*(disable|disable-file)\s*=\s*([\w\-,* ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, stable-fingerprinted for the baseline workflow.
+
+    The fingerprint hashes (rule, path, stripped source text of the line)
+    rather than the line NUMBER, so unrelated edits above a known finding
+    do not turn it into a "new" one.
+    """
+
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+    source_line: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        key = f"{self.rule}|{os.path.basename(self.path)}|" \
+              f"{self.source_line.strip()}"
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "rule": self.rule, "severity": self.severity, "path": self.path,
+            "line": self.line, "col": self.col, "message": self.message,
+            "source": self.source_line.strip(),
+            "fingerprint": self.fingerprint,
+        }
+
+    def human(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.severity}] {self.rule}: {self.message}")
+
+
+def parse_suppressions(source: str):
+    """(line -> rules) suppression maps from the comment stream.
+
+    Returns ``(per_line, whole_file)`` where ``per_line`` maps a 1-based
+    line number to the set of rule names suppressed there and
+    ``whole_file`` is the set of file-wide suppressed rules.
+    """
+    per_line: Dict[int, Set[str]] = {}
+    whole_file: Set[str] = set()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):  # half-written file
+        return per_line, whole_file
+    lines = source.splitlines()
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        kind, raw = m.group(1), m.group(2)
+        rules = {r.strip() for r in raw.split(",") if r.strip()}
+        if "all" in rules or "*" in rules:
+            rules = {"*"}
+        if kind == "disable-file":
+            whole_file |= rules
+            continue
+        row = tok.start[0]
+        text_before = lines[row - 1][:tok.start[1]].strip() \
+            if row - 1 < len(lines) else ""
+        target = row if text_before else row + 1
+        per_line.setdefault(target, set()).update(rules)
+    return per_line, whole_file
+
+
+class ModuleCtx:
+    """Everything a rule needs about one module: source, AST, parents,
+    jit-reachability, the known mesh-axis vocabulary, and suppression maps."""
+
+    def __init__(self, path: str, source: str,
+                 known_axes: Optional[Set[str]] = None):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.known_axes = known_axes or set()
+        self.suppressed_lines, self.suppressed_file = \
+            parse_suppressions(source)
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._gklint_parent = parent  # type: ignore[attr-defined]
+        self.reach = JitReachability(self.tree)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return getattr(node, "_gklint_parent", None)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def src(self, node: ast.AST) -> str:
+        line = getattr(node, "lineno", 0)
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def finding(self, rule: str, severity: str, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(rule=rule, severity=severity, path=self.path,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message, source_line=self.src(node))
+
+    def is_suppressed(self, f: Finding) -> bool:
+        if {f.rule, "*"} & self.suppressed_file:
+            return True
+        rules = self.suppressed_lines.get(f.line, set())
+        return bool({f.rule, "*"} & rules)
+
+
+def iter_py_files(paths: Sequence[str],
+                  exclude_dirs: Iterable[str] = ("tests", ".git",
+                                                 "__pycache__")) -> List[str]:
+    out: List[str] = []
+    excl = set(exclude_dirs)
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in excl)
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return out
+
+
+def lint_source(source: str, path: str = "<string>", rules=None,
+                known_axes: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint one source string (the test/fixture entry point)."""
+    from .rules import ALL_RULES
+    ctx = ModuleCtx(path, source, known_axes=known_axes)
+    found: List[Finding] = []
+    for rule in (rules if rules is not None else ALL_RULES):
+        found.extend(f for f in rule.check(ctx) if not ctx.is_suppressed(f))
+    found.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return found
+
+
+def lint_paths(paths: Sequence[str], rules=None,
+               known_axes: Optional[Set[str]] = None,
+               rel_to: Optional[str] = None) -> List[Finding]:
+    """Lint every ``.py`` under ``paths``; paths in findings are made
+    relative to ``rel_to`` (default: cwd) so baselines are machine-portable.
+    """
+    from .rules import ALL_RULES, discover_known_axes
+    files = iter_py_files(paths)
+    if known_axes is None:
+        known_axes = discover_known_axes(files)
+    base = os.path.abspath(rel_to or os.getcwd())
+    found: List[Finding] = []
+    for fpath in files:
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        rel = os.path.relpath(os.path.abspath(fpath), base)
+        try:
+            found.extend(lint_source(source, path=rel, rules=rules,
+                                     known_axes=known_axes))
+        except SyntaxError as e:
+            found.append(Finding(
+                rule="parse-error", severity="error", path=rel,
+                line=e.lineno or 0, col=(e.offset or 0),
+                message=f"file does not parse: {e.msg}"))
+    found.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return found

@@ -1,0 +1,169 @@
+"""Approximate jit-reachability over one module's AST.
+
+A function body is "traced" (executes under jit staging) when the function
+is (a) decorated with ``jax.jit`` / ``functools.partial(jax.jit, ...)``,
+(b) passed by name into ``jax.jit`` / ``shard_map`` / ``pallas_call``
+(directly or through a local *jit-wrapper* — a function that forwards one
+of its own parameters into a jit call, like trainstep's ``_smap``/``_wrap``),
+or (c) referenced from an already-traced body (covers helpers and functions
+handed to ``lax.scan`` / ``lax.cond`` / ``jax.vmap`` from traced code).
+
+This is intentionally a per-module, name-based approximation: it cannot see
+cross-module calls, and it over-approximates by treating ANY name reference
+from traced code as a call. Both error directions are handled by the
+suppression/baseline workflow; the point is catching the common hazards
+mechanically, not a sound interprocedural analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Union
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+#: callables whose function argument is staged/traced
+JIT_ENTRY_NAMES = {"jit", "shard_map", "pallas_call"}
+
+
+def _callee_name(func: ast.AST) -> Optional[str]:
+    """Terminal name of a call target: ``jax.jit`` -> 'jit', ``jit`` -> 'jit'."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_jit_entry(func: ast.AST) -> bool:
+    return _callee_name(func) in JIT_ENTRY_NAMES
+
+
+def _partial_of_jit(call: ast.Call) -> bool:
+    """``functools.partial(jax.jit, ...)`` / ``partial(jit, ...)``."""
+    return (_callee_name(call.func) == "partial" and call.args
+            and _is_jit_entry(call.args[0]))
+
+
+class JitReachability:
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self._funcs: List[FuncNode] = []
+        self._by_name: Dict[str, List[FuncNode]] = {}
+        self._enclosing: Dict[int, Optional[FuncNode]] = {}
+        self._collect(tree, None)
+        self.reachable: Set[int] = set()
+        self._wrappers = self._find_jit_wrappers()
+        self._seed_roots()
+        self._propagate()
+
+    # -- structure ---------------------------------------------------------
+    def _collect(self, node: ast.AST, enclosing: Optional[FuncNode]) -> None:
+        for child in ast.iter_child_nodes(node):
+            self._enclosing[id(child)] = enclosing
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                self._funcs.append(child)
+                name = getattr(child, "name", None)
+                if name:
+                    self._by_name.setdefault(name, []).append(child)
+                self._collect(child, child)
+            else:
+                self._collect(child, enclosing)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[FuncNode]:
+        return self._enclosing.get(id(node))
+
+    # -- roots -------------------------------------------------------------
+    def _params_of(self, fn: FuncNode) -> Set[str]:
+        a = fn.args
+        names = [p.arg for p in
+                 list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+        return set(names)
+
+    def _find_jit_wrappers(self) -> Set[str]:
+        """Names of local functions that forward a parameter into a jit
+        entry (one fixpoint pass per wrapper layer)."""
+        wrappers: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for fn in self._funcs:
+                name = getattr(fn, "name", None)
+                if not name or name in wrappers:
+                    continue
+                params = self._params_of(fn)
+                for call in ast.walk(fn):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    target = _callee_name(call.func)
+                    if not (_is_jit_entry(call.func) or target in wrappers):
+                        continue
+                    for arg in call.args:
+                        if ((isinstance(arg, ast.Name) and arg.id in params)
+                                or (isinstance(arg, ast.Call)
+                                    and isinstance(arg.func, ast.Name)
+                                    and arg.func.id in wrappers)):
+                            wrappers.add(name)
+                            changed = True
+                            break
+        return wrappers
+
+    def _seed_roots(self) -> None:
+        entry_names = JIT_ENTRY_NAMES | self._wrappers
+        for node in ast.walk(self.tree):
+            # decorator forms
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_jit_entry(dec) or (
+                            isinstance(dec, ast.Call)
+                            and (_is_jit_entry(dec.func)
+                                 or _partial_of_jit(dec))):
+                        self.reachable.add(id(node))
+            # call forms: jit(f) / shard_map(f, ...) / _wrap(f)
+            if isinstance(node, ast.Call):
+                target = _callee_name(node.func)
+                if target not in entry_names and not _partial_of_jit(node):
+                    continue
+                args = node.args[1:] if _partial_of_jit(node) else node.args
+                for arg in args:
+                    if isinstance(arg, ast.Name):
+                        for fn in self._by_name.get(arg.id, []):
+                            self.reachable.add(id(fn))
+                    elif isinstance(arg, ast.Lambda):
+                        self.reachable.add(id(arg))
+
+    # -- propagation -------------------------------------------------------
+    def _propagate(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for fn in self._funcs:
+                if id(fn) not in self.reachable:
+                    continue
+                for node in ast.walk(fn):
+                    if (isinstance(node, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.Lambda))
+                            and node is not fn
+                            and id(node) not in self.reachable):
+                        self.reachable.add(id(node))
+                        changed = True
+                    if isinstance(node, ast.Name) and \
+                            isinstance(node.ctx, ast.Load):
+                        for f2 in self._by_name.get(node.id, []):
+                            if id(f2) not in self.reachable:
+                                self.reachable.add(id(f2))
+                                changed = True
+
+    # -- queries -----------------------------------------------------------
+    def is_reachable(self, fn: FuncNode) -> bool:
+        return id(fn) in self.reachable
+
+    def in_traced_code(self, node: ast.AST) -> bool:
+        """Is ``node`` lexically inside any jit-reachable function body?"""
+        cur = self.enclosing_function(node)
+        while cur is not None:
+            if id(cur) in self.reachable:
+                return True
+            cur = self.enclosing_function(cur)
+        return False

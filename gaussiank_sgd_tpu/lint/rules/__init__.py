@@ -1,0 +1,54 @@
+"""gklint rule registry.
+
+Every rule module exposes a ``Rule`` class with ``name``, ``severity``,
+``description`` and ``check(ctx) -> Iterator[Finding]``. Adding a rule =
+adding a module here and listing it in ``ALL_RULES``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Set
+
+from . import (control_flow, donation, fail_loud, host_sync, mesh_axes,
+               recompile)
+
+ALL_RULES = [
+    host_sync.Rule(),
+    recompile.Rule(),
+    mesh_axes.Rule(),
+    donation.Rule(),
+    control_flow.Rule(),
+    fail_loud.Rule(),
+]
+
+RULES_BY_NAME = {r.name: r for r in ALL_RULES}
+
+
+def discover_known_axes(files: Sequence[str]) -> Set[str]:
+    """Union of axis names built by every ``mesh.py`` among ``files``.
+
+    The vocabulary the mesh-axis-consistency rule checks against comes from
+    the code itself (``Mesh(..., ("dp",))`` constructions), so adding an
+    axis to parallel/mesh.py automatically teaches the linter about it.
+    """
+    axes: Set[str] = set()
+    for path in files:
+        if os.path.basename(path) != "mesh.py":
+            continue
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                axes |= mesh_axes.collect_axes_from_source(fh.read())
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue
+    return axes
+
+
+def select_rules(names: Optional[Sequence[str]] = None) -> List[object]:
+    if not names:
+        return list(ALL_RULES)
+    unknown = [n for n in names if n not in RULES_BY_NAME]
+    if unknown:
+        raise KeyError(f"unknown rule(s): {', '.join(unknown)} "
+                       f"(available: {', '.join(sorted(RULES_BY_NAME))})")
+    return [RULES_BY_NAME[n] for n in names]

@@ -1,0 +1,89 @@
+"""Rule: traced-control-flow.
+
+``if``/``while`` on a traced value inside a jitted body raises
+``TracerBoolConversionError`` — but only on the first call that reaches the
+branch, which for rarely-taken paths means a latent crash in production.
+The rule flags tests that (a) directly call into ``jnp``/``lax``/``jax`` or
+(b) use a name locally bound to such a call. Static Python branching
+(``if cfg.recurrent:``, ``if fold_lr is not None:``) is untouched: ``is``
+comparisons and non-jax-rooted expressions never trigger.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set, Union
+
+from ..core import Finding, ModuleCtx
+
+NAME = "traced-control-flow"
+SEVERITY = "error"
+
+_TRACED_ROOTS = {"jnp", "lax", "jax"}
+
+
+def _attr_root(node: ast.AST) -> str:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _has_traced_call(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) and _attr_root(n.func) in _TRACED_ROOTS
+               for n in ast.walk(node))
+
+
+def _is_static_test(test: ast.AST) -> bool:
+    """`x is None` / `x is not None` — trace-time static by construction."""
+    return isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+
+
+class Rule:
+    name = NAME
+    severity = SEVERITY
+    description = ("python if/while on values produced by jnp/lax calls "
+                   "inside jitted bodies (TracerBoolConversionError)")
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not (ctx.reach.is_reachable(fn)
+                    or ctx.reach.in_traced_code(fn)):
+                continue
+            tainted = self._tainted_names(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                test = node.test
+                if _is_static_test(test):
+                    continue
+                hit = _has_traced_call(test) or any(
+                    isinstance(n, ast.Name) and n.id in tainted
+                    for n in ast.walk(test))
+                if hit:
+                    kw = "while" if isinstance(node, ast.While) else "if"
+                    yield ctx.finding(
+                        NAME, SEVERITY, node,
+                        f"python `{kw}` on a traced value inside a jitted "
+                        "body raises TracerBoolConversionError on first "
+                        "dispatch through this branch; use lax.cond / "
+                        "lax.while_loop / jnp.where")
+
+    @staticmethod
+    def _tainted_names(fn: Union[ast.FunctionDef,
+                                 ast.AsyncFunctionDef]) -> Set[str]:
+        """Names assigned (directly in this function) from jnp/lax calls."""
+        tainted: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _has_traced_call(node.value):
+                for tgt in node.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+            elif isinstance(node, ast.AugAssign) and \
+                    _has_traced_call(node.value) and \
+                    isinstance(node.target, ast.Name):
+                tainted.add(node.target.id)
+        return tainted

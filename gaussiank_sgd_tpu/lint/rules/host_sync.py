@@ -1,0 +1,87 @@
+"""Rule: host-sync-in-hot-path.
+
+Inside jit-reachable code, anything that pulls a traced value back to the
+host — ``.item()``, ``float()/int()`` on a non-constant, ``jax.device_get``,
+``jax.block_until_ready``, or a ``np.*`` call — either fails at trace time
+or, worse, silently constant-folds / forces a sync on every dispatch. The
+repo's one legitimate sync block (the trainer's per-log-interval
+``device_get`` drain) is host-loop code, which this rule never enters.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, ModuleCtx
+
+NAME = "host-sync-in-hot-path"
+SEVERITY = "error"
+
+_NP_ROOTS = {"np", "numpy", "onp"}
+_JAX_HOST_FNS = {"device_get", "block_until_ready"}
+_SCALARIZERS = {"float", "int", "bool"}
+
+
+def _attr_root(node: ast.AST) -> str:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else ""
+
+
+def _mentions_shape(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim",
+                                                           "size", "dtype")
+               for n in ast.walk(node))
+
+
+class Rule:
+    name = NAME
+    severity = SEVERITY
+    description = ("host syncs (.item(), float(), jax.device_get, np.*) "
+                   "inside jit-reachable functions")
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not ctx.reach.in_traced_code(node):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute):
+                if func.attr == "item" and not node.args:
+                    yield ctx.finding(
+                        NAME, SEVERITY, node,
+                        ".item() on a traced value blocks the dispatch "
+                        "pipeline (or fails under jit); keep the value on "
+                        "device and sync once per log interval")
+                    continue
+                if func.attr in _JAX_HOST_FNS and _attr_root(func) == "jax":
+                    yield ctx.finding(
+                        NAME, SEVERITY, node,
+                        f"jax.{func.attr} inside a jit-reachable function "
+                        "forces a host round-trip per step; hoist it to "
+                        "the host loop")
+                    continue
+                root = _attr_root(func)
+                if root in _NP_ROOTS:
+                    yield ctx.finding(
+                        NAME, SEVERITY, node,
+                        f"{root}.{func.attr}() inside a jit-reachable "
+                        "function forces the operand to the host (works "
+                        "only on trace-time constants); use jnp/lax")
+                    continue
+            elif isinstance(func, ast.Name) and func.id in _SCALARIZERS:
+                if len(node.args) != 1:
+                    continue
+                arg = node.args[0]
+                # float(2), float(cfg.lr), float(x.shape[0]) are trace-time
+                # static; only flag when the operand can plausibly be traced
+                if isinstance(arg, ast.Constant) or _mentions_shape(arg):
+                    continue
+                yield ctx.finding(
+                    NAME, SEVERITY, node,
+                    f"{func.id}() on a (possibly traced) value is a "
+                    "concretization point — a TracerConversionError under "
+                    "jit, a silent host sync outside; use jnp casts or "
+                    "sync explicitly in the host loop")

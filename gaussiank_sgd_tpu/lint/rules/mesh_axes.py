@@ -1,0 +1,91 @@
+"""Rule: mesh-axis-consistency.
+
+Mesh axis names are stringly-typed: a ``lax.psum(x, "dp ")`` or a stale
+``P("data")`` compiles fine in isolation and fails (or silently
+no-ops via an unbound-axis error far from the typo) at shard_map time.
+This rule collects every axis-name string literal — ``axis_name=`` kwargs,
+the axis argument of ``lax`` collectives, ``P(...)``/``PartitionSpec(...)``
+entries — and checks it against the vocabulary actually constructed in
+``parallel/mesh.py`` (``Mesh(..., ("dp",))`` etc.).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, List, Set
+
+from ..core import Finding, ModuleCtx
+
+NAME = "mesh-axis-consistency"
+SEVERITY = "error"
+
+#: lax/jax collectives whose SECOND positional argument is the axis name
+_AXIS_ARG1_FNS = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                  "axis_index", "axis_size", "ppermute", "psum_scatter",
+                  "all_to_all", "pshuffle"}
+_AXIS_KWARGS = {"axis_name", "axis_names", "gather_axis", "sp_axis",
+                "ici_axis", "dcn_axis"}
+_SPEC_CTORS = {"P", "PartitionSpec"}
+
+
+def collect_axes_from_source(source: str) -> Set[str]:
+    """Axis names defined by ``Mesh(...)`` constructions in one file."""
+    axes: Set[str] = set()
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal_name(node.func) == "Mesh"):
+            continue
+        candidates: List[ast.AST] = list(node.args[1:])
+        candidates += [kw.value for kw in node.keywords
+                       if kw.arg == "axis_names"]
+        for cand in candidates:
+            axes |= _str_literals(cand)
+    return axes
+
+
+def _terminal_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _str_literals(node: ast.AST) -> Set[str]:
+    return {n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+
+
+class Rule:
+    name = NAME
+    severity = SEVERITY
+    description = ("axis-name string literals (axis_name=, lax collectives, "
+                   "P(...)) checked against the axes parallel/mesh.py builds")
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        if not ctx.known_axes:
+            return  # no axis vocabulary discovered -> nothing to check
+        if os.path.basename(ctx.path) == "mesh.py":
+            return  # the defining module IS the vocabulary
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _terminal_name(node.func)
+            used: Set[str] = set()
+            if fname in _AXIS_ARG1_FNS and len(node.args) >= 2:
+                used |= _str_literals(node.args[1])
+            if fname in _SPEC_CTORS:
+                for arg in node.args:
+                    used |= _str_literals(arg)
+            for kw in node.keywords:
+                if kw.arg in _AXIS_KWARGS:
+                    used |= _str_literals(kw.value)
+            for name in sorted(used - ctx.known_axes):
+                yield ctx.finding(
+                    NAME, SEVERITY, node,
+                    f"axis name {name!r} is not an axis any mesh builder "
+                    f"constructs (known: "
+                    f"{', '.join(sorted(ctx.known_axes))}) — typo or "
+                    "stale axis name")

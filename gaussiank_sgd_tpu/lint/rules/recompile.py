@@ -1,0 +1,186 @@
+"""Rule: recompile-hazard.
+
+Three shapes of accidental recompilation:
+
+1. ``jax.jit`` / ``shard_map`` / ``pallas_call`` *constructed* inside a
+   ``for``/``while`` body — every iteration builds a fresh callable with an
+   empty compile cache, so every iteration compiles.
+2. ``static_argnums``/``static_argnames`` pointing at a parameter whose
+   annotation or default is unhashable (dict/list/set) — a guaranteed
+   ``TypeError`` on the first call.
+3. A name bound to a jitted callable invoked with a str/dict/list literal
+   argument — non-array Python arguments retrace per distinct value (str)
+   or fail outright (dict of non-arrays), the classic config-object
+   recompile hazard.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from ..core import Finding, ModuleCtx
+from ..reachability import _callee_name, _is_jit_entry
+
+NAME = "recompile-hazard"
+SEVERITY = "warning"
+
+_UNHASHABLE_ANN = {"dict", "Dict", "list", "List", "set", "Set",
+                   "MutableMapping", "defaultdict"}
+
+
+def _is_partial_jit(call: ast.Call) -> bool:
+    return (_callee_name(call.func) == "partial" and bool(call.args)
+            and _is_jit_entry(call.args[0]))
+
+
+def _ann_is_unhashable(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Name):
+        return ann.id in _UNHASHABLE_ANN
+    if isinstance(ann, ast.Subscript):  # Dict[str, int], list[int], ...
+        return _ann_is_unhashable(ann.value)
+    if isinstance(ann, ast.Attribute):  # typing.Dict
+        return ann.attr in _UNHASHABLE_ANN
+    return False
+
+
+class Rule:
+    name = NAME
+    severity = SEVERITY
+    description = ("jit/shard_map built inside loops, unhashable "
+                   "static_argnums, python-literal args to jitted callables")
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        jitted_names = self._collect_jitted_names(ctx)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                if _is_jit_entry(node.func):
+                    if self._inside_loop(ctx, node):
+                        yield ctx.finding(
+                            NAME, SEVERITY, node,
+                            f"{_callee_name(node.func)}(...) constructed "
+                            "inside a loop compiles every iteration — "
+                            "hoist the jitted callable out of the loop")
+                    yield from self._check_static_args(ctx, node)
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id in jitted_names):
+                    yield from self._check_literal_args(ctx, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # @functools.partial(jax.jit, static_argnums=...) — the
+                # jit call's target is the decorated def, not args[0]
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and _is_partial_jit(dec):
+                        yield from self._check_static_args(ctx, dec, fn=node)
+
+    # -- helpers -----------------------------------------------------------
+    def _inside_loop(self, ctx: ModuleCtx, node: ast.AST) -> bool:
+        """Lexically inside a for/while body, without an intervening
+        function boundary (a def inside a loop is only built once per
+        iteration anyway — that IS the loop body executing)."""
+        cur = node
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.For, ast.While)) and cur is not anc.iter \
+                    and cur is not getattr(anc, "test", None):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+            cur = anc
+        return False
+
+    def _collect_jitted_names(self, ctx: ModuleCtx) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_jit_entry(node.value.func):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+        return names
+
+    def _check_static_args(self, ctx: ModuleCtx, call: ast.Call,
+                           fn: Optional[ast.FunctionDef] = None,
+                           ) -> Iterator[Finding]:
+        static_nums, static_names = None, None
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                static_nums = kw.value
+            elif kw.arg == "static_argnames":
+                static_names = kw.value
+        if static_nums is None and static_names is None:
+            return
+        if fn is None:
+            fn = self._resolve_func(ctx, call)
+        if fn is None:
+            return
+        params = list(fn.args.posonlyargs) + list(fn.args.args)
+        defaults = dict(zip([p.arg for p in params][::-1],
+                            list(fn.args.defaults)[::-1]))
+
+        def flag(param: ast.arg) -> Iterator[Finding]:
+            default = defaults.get(param.arg)
+            if _ann_is_unhashable(param.annotation) or isinstance(
+                    default, (ast.Dict, ast.List, ast.Set)):
+                yield ctx.finding(
+                    NAME, SEVERITY, call,
+                    f"static_argnums/static_argnames marks parameter "
+                    f"'{param.arg}' static, but its annotation/default is "
+                    "unhashable (dict/list/set) — jit's cache lookup will "
+                    "raise TypeError; pass a hashable config or close over "
+                    "it")
+
+        for idx in self._int_elts(static_nums):
+            if 0 <= idx < len(params):
+                yield from flag(params[idx])
+        for name in self._str_elts(static_names):
+            for p in params:
+                if p.arg == name:
+                    yield from flag(p)
+
+    def _resolve_func(self, ctx: ModuleCtx,
+                      call: ast.Call) -> Optional[ast.FunctionDef]:
+        if not call.args or not isinstance(call.args[0], ast.Name):
+            return None
+        target = call.args[0].id
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == target:
+                return node
+        return None
+
+    @staticmethod
+    def _int_elts(node: Optional[ast.AST]):
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            yield node.value
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    yield e.value
+
+    @staticmethod
+    def _str_elts(node: Optional[ast.AST]):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    yield e.value
+
+    def _check_literal_args(self, ctx: ModuleCtx,
+                            call: ast.Call) -> Iterator[Finding]:
+        for arg in call.args:
+            if isinstance(arg, ast.Dict):
+                kind = "dict"
+            elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                kind = "str"
+            else:
+                continue
+            yield ctx.finding(
+                NAME, SEVERITY, call,
+                f"jitted callable '{_callee_name(call.func)}' invoked with "
+                f"a {kind} literal argument — non-array Python arguments "
+                "retrace on every distinct value (or fail); mark the "
+                "parameter static or close over it")

@@ -33,9 +33,9 @@ TPU-shaped two-level scheme:
      buffer plus the (tiny) candidate tiles.
   2. **In-XLA (small)**: the candidate buffer has ``nc = n/SEG`` slots
      (64x smaller than the gradient at the contract density), so a top-k
-     over candidate magnitudes — exact ``lax.top_k`` up to 512k
-     candidates, ``approx_max_k`` beyond (misses defer to EF) — picks the
-     final k pairs in f32.
+     over candidate magnitudes — exact ``lax.top_k`` up to 128k
+     candidates (``_EXACT_CAND_MAX`` = 1<<17), ``approx_max_k`` beyond
+     (misses defer to EF) — picks the final k pairs in f32.
 
 Selection contract vs ``pack_by_mask(priority="magnitude")``: identical mask
 (``|acc| > t``), identical exact EF bookkeeping (the caller zeroes exactly

@@ -20,13 +20,14 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.typing import DTypeLike
 
 from ..compressors.base import CompressedGrad
 
 
 def sparse_allgather_sum(comp: CompressedGrad, numel: int, axis_name: str,
                          *, mean: bool = True,
-                         dtype=jnp.float32) -> jax.Array:
+                         dtype: DTypeLike = jnp.float32) -> jax.Array:
     """All-gather each worker's packed (idx, val) pairs and scatter-sum dense.
 
     The TPU lowering of the reference's sparse path (SURVEY.md §3.1 COMM
@@ -61,7 +62,8 @@ def dense_allreduce(flat: jax.Array, axis_name: str,
 def hierarchical_sparse_allgather_sum(comp: CompressedGrad, numel: int,
                                       ici_axis: str, dcn_axis: str,
                                       *, mean: bool = True,
-                                      dtype=jnp.float32) -> jax.Array:
+                                      dtype: DTypeLike = jnp.float32,
+                                      ) -> jax.Array:
     """Two-level exchange for multi-slice meshes (SURVEY.md §7 hard part 3).
 
     Sparse allgather + scatter-sum over the fast ICI axis first, then a dense

@@ -60,7 +60,8 @@ class FlatSGDM(NamedTuple):
         """The dense half of the update: mu*m (+ wd*p)."""
         m = m * self.momentum if self.momentum else jnp.zeros_like(m)
         if self.weight_decay:
-            assert flat_params is not None
+            # internal invariant: both callers gate on _flat_params_if_wd
+            assert flat_params is not None  # gklint: disable=fail-loud
             m = m + self.weight_decay * flat_params.astype(m.dtype)
         return m
 

@@ -19,7 +19,7 @@ Axis convention:
 from __future__ import annotations
 
 import os
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
@@ -152,7 +152,8 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharded(mesh: Mesh, axes=None) -> NamedSharding:
+def batch_sharded(mesh: Mesh,
+                  axes: str | Sequence[str] | None = None) -> NamedSharding:
     """Sharding for a batch: leading dim split across the data-parallel axes.
 
     Defaults to *all* mesh axes, which is correct for both the 1-D ``('dp',)``
@@ -163,7 +164,7 @@ def batch_sharded(mesh: Mesh, axes=None) -> NamedSharding:
     return NamedSharding(mesh, P(axes))
 
 
-def shard_batch(mesh: Mesh, batch, spec: Optional[P] = None):
+def shard_batch(mesh: Mesh, batch: Any, spec: Optional[P] = None) -> Any:
     """Place a host batch onto the mesh; leading dim sharded over dp by
     default, or per ``spec`` (e.g. ``P('dp', 'sp')`` for sequence-parallel
     batches whose dim 1 shards over the sp axis)."""

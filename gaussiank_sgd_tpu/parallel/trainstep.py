@@ -33,10 +33,13 @@ from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import optax
-from jax import lax, shard_map
+from jax import lax
 from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, PartitionSpec as P
+from jax.typing import DTypeLike
 
+from .. import compat
+from ..compat import shard_map
 from ..compressors.base import CompressedGrad, decompress
 from ..compressors.registry import CompressorSpec
 from .bucketing import BucketPlan
@@ -200,7 +203,8 @@ def _clip_by_global_norm(flat_g: jax.Array, clip: Optional[float]):
 
 
 def compress_buckets(spec: CompressorSpec, plan: BucketPlan, acc: jax.Array,
-                     rng: jax.Array, comp_state: Any = ()):
+                     rng: jax.Array, comp_state: Any = (),
+                     ) -> Tuple[CompressedGrad, jax.Array, jax.Array, Any]:
     """Run the compressor over every bucket; concat packed pairs globally.
 
     Bucket-local indices are offset into the global flat space so the whole
@@ -305,7 +309,7 @@ def build_dp_train_step(
     num_microbatches: int = 1,
     clip_norm: Optional[float] = None,
     fold_lr: Optional[Callable[[jax.Array], jax.Array]] = None,
-    grad_dtype=jnp.float32,
+    grad_dtype: DTypeLike = jnp.float32,
     exchange: str = "allgather",
     recurrent: bool = False,
     sp_axis: Optional[str] = None,
@@ -344,14 +348,18 @@ def build_dp_train_step(
     """
     axes = tuple(mesh.axis_names)
     if sp_axis is not None:
-        assert sp_axis == axes[-1], (
-            f"sp_axis {sp_axis!r} must be the mesh's last axis {axes!r}")
-        assert not recurrent, "recurrent carry + sequence parallelism is " \
-                              "not supported (carry rows are batch rows)"
+        if sp_axis != axes[-1]:
+            raise ValueError(
+                f"sp_axis {sp_axis!r} must be the mesh's last axis {axes!r}")
+        if recurrent:
+            raise ValueError(
+                "recurrent carry + sequence parallelism is not supported "
+                "(carry rows are batch rows)")
     if exchange == "gtopk":
-        assert len(axes) == 1, "gtopk exchange supports 1-D dp meshes only"
-        assert mesh.size & (mesh.size - 1) == 0, \
-            "gtopk exchange needs a power-of-2 dp width"
+        if len(axes) != 1:
+            raise ValueError("gtopk exchange supports 1-D dp meshes only")
+        if mesh.size & (mesh.size - 1) != 0:
+            raise ValueError("gtopk exchange needs a power-of-2 dp width")
     elif exchange != "allgather":
         raise ValueError(f"unknown exchange {exchange!r}")
     gather_axis = axes[-1]          # ICI axis on hierarchical meshes
@@ -386,7 +394,7 @@ def build_dp_train_step(
     def _linear_device_index():
         idx = jnp.int32(0)
         for a in axes:
-            idx = idx * lax.axis_size(a) + lax.axis_index(a)
+            idx = idx * compat.axis_size(a) + lax.axis_index(a)
         return idx
 
     def _step_rngs(state: TrainState):
@@ -620,11 +628,13 @@ def build_dp_train_step(
     def init_state(params: Any, rng: jax.Array,
                    model_state: Any = None, carry: Any = ()) -> TrainState:
         flat, _ = ravel_pytree(params)
-        assert flat.size == n_total, (
-            f"bucket plan built for {n_total} params, model has {flat.size}")
-        if recurrent:
-            assert jax.tree_util.tree_leaves(carry), \
-                "recurrent=True needs an initial carry (model.initial_carry)"
+        if flat.size != n_total:
+            raise ValueError(
+                f"bucket plan built for {n_total} params, model has "
+                f"{flat.size}")
+        if recurrent and not jax.tree_util.tree_leaves(carry):
+            raise ValueError(
+                "recurrent=True needs an initial carry (model.initial_carry)")
         # The step functions donate their input state; copy so the caller's
         # param buffers are never invalidated (and two states can share an
         # init pytree).

@@ -117,7 +117,9 @@ def restore_checkpoint(path: str, target: TrainState,
 
     # detect a worker-count mismatch from the checkpoint's own metadata
     # (on disk ef_residual is [P, N]; live it is flat [P*N])
-    meta = ckptr.metadata(path).item_metadata
+    meta = ckptr.metadata(path)
+    # newer orbax wraps the tree in CheckpointMetadata; older returns it bare
+    meta = getattr(meta, "item_metadata", meta)
     old_p = int(meta["ef_residual"].shape[0])
     ef_dtype = target.ef_residual.dtype
     n_flat = int(meta["ef_residual"].shape[1])
@@ -142,8 +144,24 @@ def restore_checkpoint(path: str, target: TrainState,
     tgt_opt = target.opt_state
     flat_target = isinstance(tgt_opt, dict) and set(tgt_opt) == {"m"}
     meta_opt = meta["opt_state"]
-    legacy_opt = (flat_target and not (
-        isinstance(meta_opt, dict) and set(meta_opt) == {"m"}))
+    flat_ckpt = isinstance(meta_opt, dict) and set(meta_opt) == {"m"}
+    legacy_opt = flat_target and not flat_ckpt
+    if flat_ckpt and not flat_target:
+        # the inverse direction is NOT handled: a flat-opt checkpoint's
+        # single [n] momentum buffer cannot be restored into an optax
+        # chain's tree without the params treedef-driven unravel, and
+        # letting orbax attempt it dies in an opaque structure-mismatch
+        # error. Fail loud with the actual cause (ADVICE r5; repo
+        # convention, code-review r4). Trigger: the trainer auto-flips
+        # flat_opt off when the resumed config changes (nesterov=True,
+        # fold_lr, hierarchical/sp mesh, or momentum=weight_decay=0).
+        raise ValueError(
+            "checkpoint was written by the flat sparse-aware optimizer "
+            "(opt_state == {'m'}) but this run uses the optax path — "
+            "resume with a flat-opt-compatible config (1-D dp mesh, no "
+            "nesterov/fold_lr, momentum or weight_decay nonzero), or "
+            "retrain; converting flat momentum back into an optax trace "
+            "is not supported")
 
     def _opt_abstract(sharding=None):
         if legacy_opt:

@@ -8,7 +8,7 @@ Transformer target (BASELINE config 5).
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -51,7 +51,8 @@ def _apply(spec: ModelSpec, params, mstate, rng, *inputs, **extra):
 
 
 def make_loss_fn(spec: ModelSpec, label_smoothing: float = 0.0,
-                 recurrent: bool = False, input_norm=None) -> Callable:
+                 recurrent: bool = False,
+                 input_norm: Optional[Callable] = None) -> Callable:
     """``recurrent=True`` (lm only): the carry-threading LossFn protocol of
     parallel/trainstep.py — consume the previous window's hidden state,
     return the new one (the reference's bptt repackaging, SURVEY.md §3.2).
@@ -129,7 +130,7 @@ def make_loss_fn(spec: ModelSpec, label_smoothing: float = 0.0,
     raise ValueError(f"unknown task {task!r}")
 
 
-def ctc_greedy_decode(logits: jax.Array):
+def ctc_greedy_decode(logits: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Greedy (best-path) CTC decode: per-frame argmax, collapse repeats,
     drop blanks (blank_id = 0, optax.ctc_loss's default and the label-pad
     convention of data/audio.py).
@@ -177,7 +178,8 @@ def _edit_distance_one(hyp, hyp_mask, ref, ref_mask):
     return row[ref_len], ref_len
 
 
-def char_error_counts(logits: jax.Array, labels: jax.Array):
+def char_error_counts(logits: jax.Array, labels: jax.Array,
+                      ) -> tuple[jax.Array, jax.Array]:
     """(edit_distance_sum, ref_char_sum) for a batch — CER numerator and
     denominator, summable across eval shards (labels == 0 is padding)."""
     hyp, hyp_mask = ctc_greedy_decode(logits)
@@ -189,7 +191,7 @@ def char_error_counts(logits: jax.Array, labels: jax.Array):
 
 
 def make_eval_fn(spec: ModelSpec, recurrent: bool = False,
-                 input_norm=None) -> Callable:
+                 input_norm: Optional[Callable] = None) -> Callable:
     """(params, mstate, batch) -> dict of SUMS (caller psums + normalizes).
 
     Eval-mode apply (train=False, running BatchNorm stats, no dropout).

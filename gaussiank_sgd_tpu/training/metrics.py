@@ -19,7 +19,7 @@ from typing import Any, Dict, Optional
 
 def make_logger(name: str = "gaussiank_sgd_tpu",
                 log_file: Optional[str] = None,
-                level=logging.INFO) -> logging.Logger:
+                level: int = logging.INFO) -> logging.Logger:
     logger = logging.getLogger(name)
     logger.setLevel(level)
     logger.propagate = False

@@ -31,6 +31,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from .. import data as data_lib
+from ..compat import shard_map
 from .. import models as models_lib
 from ..compressors import get_compressor
 from ..parallel.bucketing import plan_for_params
@@ -74,12 +75,14 @@ class Trainer:
         # ---- mesh (SURVEY.md §3.1: hvd.init + device binding -> mesh) ----
         self.sp = cfg.sp_size if cfg.sp_size > 1 else 0
         if self.sp:
-            assert cfg.dnn.lower() in ("transformer_lm", "transformerlm"), \
-                "sequence parallelism (--sp-size) is the transformer_lm " \
-                "long-context path"
-            assert not (cfg.ici_size or cfg.dcn_size), \
-                "--sp-size and --ici-size/--dcn-size are mutually " \
-                "exclusive mesh layouts"
+            if cfg.dnn.lower() not in ("transformer_lm", "transformerlm"):
+                raise ValueError(
+                    "sequence parallelism (--sp-size) is the transformer_lm "
+                    "long-context path")
+            if cfg.ici_size or cfg.dcn_size:
+                raise ValueError(
+                    "--sp-size and --ici-size/--dcn-size are mutually "
+                    "exclusive mesh layouts")
             dp = cfg.nworkers if cfg.nworkers > 0 else (
                 len(jax.devices()) // self.sp)
             self.mesh = dp_sp_mesh(dp, self.sp)
@@ -161,7 +164,12 @@ class Trainer:
         from ..parallel.flat_opt import FlatSGDM
         flat_opt = None
         if (not cfg.nesterov and not cfg.fold_lr
-                and len(self.mesh.axis_names) == 1):
+                and len(self.mesh.axis_names) == 1
+                and (cfg.momentum or cfg.weight_decay)):
+            # momentum-less, decay-less SGD needs NO optimizer state; the
+            # flat path would still allocate and rewrite an n-sized zero
+            # momentum buffer every step (wasted HBM traffic + a checkpoint
+            # format change), so such runs stay on the optax path (ADVICE r5)
             flat_opt = FlatSGDM(lr=self.schedule,
                                 momentum=cfg.momentum or 0.0,
                                 weight_decay=cfg.weight_decay or 0.0)
@@ -220,7 +228,7 @@ class Trainer:
         in_specs = (P(), P(), batch_in) + ((P(axes),) if self.recurrent
                                            else ())
         out_specs = (P(), P(axes)) if self.recurrent else P()
-        self.eval_step = jax.jit(jax.shard_map(
+        self.eval_step = jax.jit(shard_map(
             eval_step, mesh=self.mesh,
             in_specs=in_specs, out_specs=out_specs, check_vma=False))
 

@@ -16,6 +16,7 @@ harmless.
 from __future__ import annotations
 
 import os
+import re
 
 
 def provision(n_devices: int) -> None:
@@ -32,10 +33,17 @@ def provision(n_devices: int) -> None:
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+    if "xla_force_host_platform_device_count" in flags:
+        # an inherited flag (parent test process) may carry a DIFFERENT
+        # count — overwrite, don't keep it, or subprocess tests that want
+        # a wider mesh (e.g. w32) silently get the parent's width
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       f"--xla_force_host_platform_device_count={n_devices}",
+                       flags)
+    else:
+        flags = (flags
+                 + f" --xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = flags.strip()
 
     import jax
     import chex  # noqa: F401
@@ -46,7 +54,19 @@ def provision(n_devices: int) -> None:
     for name in ("axon", "tpu"):
         xb._backend_factories.pop(name, None)
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", int(n_devices))
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n_devices))
+    except AttributeError:
+        # older jax has no jax_num_cpu_devices option; the
+        # --xla_force_host_platform_device_count flag set above (step 1)
+        # provisions the devices as long as the backend is uninitialized
+        if len(jax.devices()) != int(n_devices):
+            raise RuntimeError(
+                f"virtual CPU provisioning failed: jax has no "
+                f"jax_num_cpu_devices option and the XLA_FLAGS fallback "
+                f"yielded {len(jax.devices())} devices (wanted "
+                f"{n_devices}) — provision() must run before any jax "
+                f"operation initializes the backend")
 
 
 def enable_compile_cache(path: str | None = None) -> None:

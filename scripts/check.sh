@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# The repo's check gate (docs/LINTING.md): gklint -> typecheck -> tier-1
+# tests, in cheap-to-expensive order so CI fails fast on style/static
+# errors before burning 12 minutes of pytest.
+#
+#   scripts/check.sh             # everything
+#   scripts/check.sh --no-tests  # lint + typecheck only (pre-commit speed)
+#
+# Exit nonzero on the first failing stage.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUN_TESTS=1
+if [[ "${1:-}" == "--no-tests" ]]; then
+  RUN_TESTS=0
+fi
+
+echo "== gklint (JAX-aware static analysis) =="
+# pure-AST: no device/platform init. --json kept for CI log scraping;
+# exits 1 on findings not in the committed .gklint-baseline.json
+python -m gaussiank_sgd_tpu.lint
+
+echo "== typecheck (mypy) =="
+if command -v mypy >/dev/null 2>&1; then
+  mypy --config-file mypy.ini
+else
+  # the dev container bakes the jax toolchain but not mypy, and installing
+  # is not allowed there; CI (.github/workflows/check.yml) installs it
+  echo "mypy not installed — skipping typecheck (CI runs it)"
+fi
+
+if [[ "${RUN_TESTS}" == "1" ]]; then
+  echo "== tier-1 tests =="
+  # ROADMAP.md tier-1 verify command (870s budget, 8-device virtual CPU)
+  rm -f /tmp/_t1.log
+  timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee /tmp/_t1.log
+  rc=${PIPESTATUS[0]}
+  echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+  exit "${rc}"
+fi

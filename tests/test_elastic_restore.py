@@ -21,7 +21,7 @@ from gaussiank_sgd_tpu.training.checkpoint import (restore_checkpoint,
                                                    save_checkpoint)
 
 
-def _problem(n_dev, batch=16):
+def _problem(n_dev, batch=16, optimizer=None, flat_opt=None):
     import flax.linen as nn
 
     class M(nn.Module):
@@ -42,7 +42,10 @@ def _problem(n_dev, batch=16):
     mesh = data_parallel_mesh(n_dev)
     comp = get_compressor("gaussian", density=0.1)
     plan = plan_for_params(v["params"], 0.1)
-    ts = build_dp_train_step(loss_fn, optax.sgd(0.1), comp, plan, mesh)
+    if flat_opt is None and optimizer is None:
+        optimizer = optax.sgd(0.1)
+    ts = build_dp_train_step(loss_fn, optimizer, comp, plan, mesh,
+                             flat_opt=flat_opt)
     state = ts.init_state(v["params"], jax.random.PRNGKey(2))
     return ts, state, shard_batch(mesh, (x, y))
 
@@ -196,3 +199,61 @@ def test_legacy_optax_checkpoint_restores_into_flat_opt(tmp_path):
     assert set(restored.opt_state) == {"m"}
     assert restored.opt_state["m"].size == \
         ravel_pytree(s8.params)[0].size
+
+
+def test_legacy_optax_momentum_ravels_into_flat_opt(tmp_path):
+    """The momentum carry-over itself (ADVICE r5): a checkpoint written by
+    optax.chain(add_decayed_weights, sgd(momentum=0.9)) restores into a
+    flat-opt run with opt_state['m'] == ravel_pytree(trace) — the trace
+    mirrors the params tree, so ravel order == the flat index space."""
+    from jax.flatten_util import ravel_pytree
+
+    from gaussiank_sgd_tpu.parallel.flat_opt import FlatSGDM
+
+    legacy = optax.chain(optax.add_decayed_weights(1e-4),
+                         optax.sgd(0.1, momentum=0.9))
+    ts8, s8, b8 = _problem(8, optimizer=legacy)
+    for _ in range(3):                       # build up a nonzero trace
+        s8, _ = ts8.sparse_step(s8, b8)
+    path = save_checkpoint(str(tmp_path / "ck"), s8)
+
+    def find_trace(node):
+        if hasattr(node, "trace"):
+            return node.trace
+        if isinstance(node, (list, tuple)):
+            for v in node:
+                r = find_trace(v)
+                if r is not None:
+                    return r
+        return None
+
+    trace = find_trace(s8.opt_state)
+    assert trace is not None
+    flat_trace, _ = ravel_pytree(trace)
+    assert float(jnp.abs(flat_trace).sum()) > 0
+
+    ts_f, s_f, _ = _problem(
+        8, flat_opt=FlatSGDM(lr=0.1, momentum=0.9, weight_decay=1e-4))
+    restored = restore_checkpoint(path, s_f, ts_f.mesh)
+    assert set(restored.opt_state) == {"m"}
+    np.testing.assert_allclose(np.asarray(restored.opt_state["m"]),
+                               np.asarray(flat_trace), rtol=1e-6, atol=0)
+    for a, b in zip(jax.tree_util.tree_leaves(restored.params),
+                    jax.tree_util.tree_leaves(s8.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_flat_opt_checkpoint_into_optax_run_fails_loud(tmp_path):
+    """The inverse direction (flat-opt checkpoint -> optax-path run) is
+    unsupported; it must raise the descriptive ValueError, not die inside
+    orbax with a structure mismatch (ADVICE r5)."""
+    from gaussiank_sgd_tpu.parallel.flat_opt import FlatSGDM
+
+    ts_f, s_f, b_f = _problem(8, flat_opt=FlatSGDM(lr=0.1, momentum=0.9))
+    s_f, _ = ts_f.sparse_step(s_f, b_f)
+    path = save_checkpoint(str(tmp_path / "ck"), s_f)
+
+    ts_o, s_o, _ = _problem(
+        8, optimizer=optax.sgd(0.1, momentum=0.9, nesterov=True))
+    with pytest.raises(ValueError, match="flat sparse-aware optimizer"):
+        restore_checkpoint(path, s_o, ts_o.mesh)

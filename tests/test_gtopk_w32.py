@@ -19,7 +19,7 @@ virtual_cpu.enable_compile_cache()
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from gaussiank_sgd_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from gaussiank_sgd_tpu.compressors import get_compressor

@@ -1,0 +1,390 @@
+"""gklint rule coverage: every rule with a positive (fires) and a negative
+(stays quiet) fixture, the suppression-comment path, baseline round-trip,
+and the CLI exit-code contract. Pure-AST — no jax device init needed, so
+these are the fastest tests in the suite.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from gaussiank_sgd_tpu.lint import (
+    ALL_RULES, RULES_BY_NAME, default_baseline_path, lint_paths, lint_source,
+    load_baseline, select_rules, split_new, write_baseline,
+)
+from gaussiank_sgd_tpu.lint.rules import discover_known_axes
+
+AXES = {"dp", "ici_dp", "dcn_dp", "sp"}
+
+
+def run(src, rule=None, known_axes=AXES, path="fixture.py"):
+    rules = [RULES_BY_NAME[rule]] if rule else None
+    return lint_source(textwrap.dedent(src), path=path, rules=rules,
+                       known_axes=known_axes)
+
+
+# ---------------------------------------------------------------- host-sync
+
+def test_host_sync_flags_item_float_np_in_jitted_fn():
+    found = run("""
+        import jax, jax.numpy as jnp, numpy as np
+
+        @jax.jit
+        def step(x):
+            s = x.sum().item()
+            f = float(x[0])
+            h = np.sum(x)
+            jax.device_get(x)
+            return s + f + h
+        """, rule="host-sync-in-hot-path")
+    assert len(found) == 4
+    assert all(f.severity == "error" for f in found)
+
+
+def test_host_sync_quiet_outside_jit_and_on_shapes():
+    found = run("""
+        import jax, numpy as np
+
+        def logger(x):               # never jitted: host code is fine
+            print(float(x), np.mean(x))
+
+        @jax.jit
+        def step(x):
+            n = float(x.shape[0])    # static shape arithmetic is host-safe
+            return x * n
+        """, rule="host-sync-in-hot-path")
+    assert found == []
+
+
+def test_host_sync_sees_through_jit_wrapper():
+    """The trainstep _wrap pattern: fn passed through a helper that jits
+    it. The wrapper fixpoint must mark the callee reachable."""
+    found = run("""
+        import jax
+
+        def _wrap(fn):
+            return jax.jit(fn, donate_argnums=(0,))
+
+        def sparse_step(state, batch):
+            jax.device_get(state)
+            return state
+
+        step = _wrap(sparse_step)
+        """, rule="host-sync-in-hot-path")
+    assert [f.line for f in found] and "device_get" in found[0].message
+
+
+# ---------------------------------------------------------------- recompile
+
+def test_recompile_flags_jit_in_loop_and_unhashable_static():
+    found = run("""
+        import jax
+
+        def train(steps, fns):
+            for _ in range(steps):
+                f = jax.jit(lambda x: x + 1)   # re-traces every iteration
+
+        @jax.jit
+        def g(x, cfg={}):
+            return x
+
+        g2 = jax.jit(lambda x, cfg: x, static_argnums=(1,))
+        """, rule="recompile-hazard")
+    assert len(found) >= 1
+    assert any("loop" in f.message for f in found)
+
+
+def test_recompile_quiet_on_module_level_jit():
+    found = run("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x + 1
+
+        g = jax.jit(lambda x: x * 2)
+        """, rule="recompile-hazard")
+    assert found == []
+
+
+def test_recompile_static_argnums_unhashable_annotation():
+    found = run("""
+        import functools, jax
+        from typing import Dict
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def f(x, cfg: Dict[str, int]):
+            return x
+        """, rule="recompile-hazard")
+    assert len(found) == 1 and "static" in found[0].message
+
+
+# ---------------------------------------------------------------- mesh-axes
+
+def test_mesh_axis_typo_in_collective_and_pspec():
+    found = run("""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def f(x):
+            g = jax.lax.psum(x, "dp ")          # trailing space
+            spec = P("data", None)               # not a repo axis
+            return g, spec
+        """, rule="mesh-axis-consistency")
+    assert len(found) == 2
+    assert all(f.severity == "error" for f in found)
+
+
+def test_mesh_axis_known_names_pass():
+    found = run("""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def f(x):
+            g = jax.lax.psum(x, "dp")
+            h = jax.lax.all_gather(x, "sp")
+            spec = P(("ici_dp", "dcn_dp"))
+            return g, h, spec
+        """, rule="mesh-axis-consistency")
+    assert found == []
+
+
+def test_mesh_axis_rule_silent_without_vocabulary():
+    # no known axes discovered -> the rule cannot judge, so it stays quiet
+    found = run("""
+        import jax
+        def f(x):
+            return jax.lax.psum(x, "anything")
+        """, rule="mesh-axis-consistency", known_axes=set())
+    assert found == []
+
+
+def test_discover_known_axes_reads_real_mesh_py():
+    import gaussiank_sgd_tpu.parallel.mesh as m
+    axes = discover_known_axes([m.__file__])
+    assert {"dp", "sp", "ici_dp", "dcn_dp"} <= axes
+
+
+# ----------------------------------------------------------------- donation
+
+def test_donation_flags_undonated_train_step():
+    found = run("""
+        import jax
+
+        @jax.jit
+        def train_step(state, batch):
+            return state
+
+        other = jax.jit(lambda s, b: s)  # not step-named: exempt
+        """, rule="donation-check")
+    assert len(found) == 1 and "donate" in found[0].message
+
+
+def test_donation_quiet_when_donated_or_eval():
+    found = run("""
+        import jax
+
+        @jax.jit
+        def eval_step(state, batch):     # eval reuses state: exempt
+            return state
+
+        train_step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+        """, rule="donation-check")
+    assert found == []
+
+
+# ------------------------------------------------------------- control-flow
+
+def test_control_flow_flags_if_on_traced_value():
+    found = run("""
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = jnp.sum(x)
+            if y > 0:                    # TracerBoolConversionError at run
+                return y
+            while jnp.max(x) > 1:
+                x = x / 2
+            return x
+        """, rule="traced-control-flow")
+    assert len(found) == 2
+    assert all(f.severity == "error" for f in found)
+
+
+def test_control_flow_quiet_on_static_python():
+    found = run("""
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def f(x, causal=True):
+            if causal:                   # python-level flag: fine
+                x = x + 1
+            if x is None:                # identity checks are static
+                return jnp.zeros(())
+            return jnp.where(x > 0, x, -x)   # traced select: the fix
+        """, rule="traced-control-flow")
+    assert found == []
+
+
+# -------------------------------------------------------------- fail-loud
+
+def test_fail_loud_flags_bare_except_and_assert():
+    found = run("""
+        def f(x):
+            assert x > 0, "positive"
+            try:
+                return 1 / x
+            except:
+                return 0
+        """, rule="fail-loud")
+    assert len(found) == 2
+    assert all(f.severity == "warning" for f in found)
+
+
+def test_fail_loud_quiet_on_typed_except_and_raise():
+    found = run("""
+        def f(x):
+            if x <= 0:
+                raise ValueError("positive required")
+            try:
+                return 1 / x
+            except ZeroDivisionError:
+                return 0
+        """, rule="fail-loud")
+    assert found == []
+
+
+# ------------------------------------------------------------- suppression
+
+def test_trailing_suppression_comment():
+    found = run("""
+        def f(x):
+            assert x > 0  # gklint: disable=fail-loud
+            assert x < 9  # this one still fires
+        """, rule="fail-loud")
+    assert len(found) == 1 and found[0].line == 4
+
+
+def test_standalone_suppression_applies_to_next_line():
+    found = run("""
+        def f(x):
+            # gklint: disable=fail-loud
+            assert x > 0
+        """, rule="fail-loud")
+    assert found == []
+
+
+def test_file_level_and_wildcard_suppression():
+    assert run("""
+        # gklint: disable-file=fail-loud
+        def f(x):
+            assert x > 0
+        """, rule="fail-loud") == []
+    assert run("""
+        def f(x):
+            assert x > 0  # gklint: disable=all
+        """, rule="fail-loud") == []
+
+
+def test_suppressing_one_rule_keeps_others():
+    found = run("""
+        import jax
+
+        @jax.jit
+        def train_step(state, batch):  # gklint: disable=donation-check
+            assert state is not None
+            return state
+        """)
+    assert {f.rule for f in found} == {"fail-loud"}
+
+
+# ----------------------------------------------------- baseline round-trip
+
+def test_baseline_roundtrip_and_split(tmp_path):
+    src = textwrap.dedent("""
+        def f(x):
+            assert x > 0
+        """)
+    found = lint_source(src, path="mod.py")
+    bp = tmp_path / "baseline.json"
+    write_baseline(str(bp), found)
+    baseline = load_baseline(str(bp))
+    new, old = split_new(found, baseline)
+    assert new == [] and len(old) == len(found)
+
+    # an extra finding of the same rule on a NEW line is new; the original
+    # stays baselined even though its line number moved
+    src2 = textwrap.dedent("""
+        import os
+
+        def f(x):
+            assert x > 0
+            assert x < 9
+        """)
+    found2 = lint_source(src2, path="mod.py")
+    new2, old2 = split_new(found2, baseline)
+    assert len(old2) == 1 and len(new2) == 1
+    assert "x < 9" in new2[0].source_line
+
+
+def test_select_rules_unknown_name_raises():
+    assert len(select_rules(["fail-loud"])) == 1
+    with pytest.raises(KeyError):
+        select_rules(["no-such-rule"])
+
+
+# ------------------------------------------------------------------- CLI
+
+def _cli(*argv, cwd=None):
+    return subprocess.run(
+        [sys.executable, "-m", "gaussiank_sgd_tpu.lint", *argv],
+        capture_output=True, text=True, cwd=cwd)
+
+
+def test_cli_json_exits_nonzero_on_new_findings(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x):\n    assert x > 0\n")
+    r = _cli(str(bad), "--json", "--no-baseline")
+    assert r.returncode == 1
+    out = json.loads(r.stdout)
+    assert out["counts"]["new"] == 1
+    assert out["new_findings"][0]["rule"] == "fail-loud"
+
+
+def test_cli_clean_after_write_baseline(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x):\n    assert x > 0\n")
+    bp = tmp_path / "b.json"
+    assert _cli(str(bad), "--baseline", str(bp),
+                "--write-baseline").returncode == 0
+    assert _cli(str(bad), "--baseline", str(bp)).returncode == 0
+    # a new finding gates again
+    bad.write_text("def f(x):\n    assert x > 0\n    assert x < 9\n")
+    r = _cli(str(bad), "--baseline", str(bp), "--json")
+    assert r.returncode == 1
+    assert json.loads(r.stdout)["counts"]["new"] == 1
+
+
+def test_cli_list_rules_names_all_six():
+    r = _cli("--list-rules")
+    assert r.returncode == 0
+    for rule in ALL_RULES:
+        assert rule.name in r.stdout
+    assert len(ALL_RULES) == 6
+
+
+def test_package_is_clean_against_committed_baseline():
+    """The shipped gate: linting the real package yields no findings
+    beyond the committed baseline (host-sync and mesh-axis rules thereby
+    validated against real code, not just fixtures)."""
+    import gaussiank_sgd_tpu
+    import os
+    pkg = os.path.dirname(gaussiank_sgd_tpu.__file__)
+    findings = lint_paths([pkg], rel_to=os.path.dirname(pkg))
+    baseline = load_baseline(default_baseline_path())
+    new, _ = split_new(findings, baseline)
+    assert new == [], "\n".join(f.human() for f in new)

@@ -13,6 +13,7 @@ import optax
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
+from gaussiank_sgd_tpu.compat import shard_map
 from gaussiank_sgd_tpu.parallel.mesh import data_parallel_mesh, dp_sp_mesh
 from gaussiank_sgd_tpu.parallel.ring_attention import ring_attention
 
@@ -37,7 +38,7 @@ def test_ring_matches_full_attention(causal):
     ref = full_attention(q, k, v, causal)
 
     mesh = Mesh(np.asarray(jax.devices()[:sp]), ("sp",))
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         functools.partial(ring_attention, axis_name="sp", causal=causal),
         mesh=mesh,
         in_specs=(P(None, None, "sp"), P(None, None, "sp"),
@@ -54,7 +55,7 @@ def test_ring_single_shard_degenerates_to_local():
     q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (b, h, t, d))
                for i in range(3))
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("sp",))
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         functools.partial(ring_attention, axis_name="sp", causal=True),
         mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
         check_vma=False))
@@ -85,7 +86,7 @@ def test_sp_transformer_lm_matches_single_device():
     def fwd(variables, tok):
         return spec_sp.module.apply(variables, tok, train=False)
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         fwd, mesh=mesh, in_specs=(P(), P(None, "sp")),
         out_specs=P(None, "sp"), check_vma=False))
     sp_logits = f(v, toks)
@@ -138,7 +139,7 @@ def test_ring_long_context_512():
                for i in range(3))
     ref = full_attention(q, k, v, causal=True)
     mesh = Mesh(np.asarray(jax.devices()[:sp]), ("sp",))
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         functools.partial(ring_attention, axis_name="sp", causal=True),
         mesh=mesh,
         in_specs=(P(None, None, "sp"),) * 3,
@@ -179,6 +180,6 @@ def test_sp_rejects_bad_configs():
     mesh = dp_sp_mesh(2, 4)
     plan = make_bucket_plan([100], 0.1)
     comp = get_compressor("topk", density=0.1)
-    with pytest.raises(AssertionError, match="last axis"):
+    with pytest.raises(ValueError, match="last axis"):
         build_dp_train_step(lambda *a: None, optax.sgd(0.1), comp, plan,
                             mesh, sp_axis="dp")
