@@ -14,9 +14,16 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
+
+# Errors the prefetch thread treats as transient and retries with bounded
+# exponential backoff: the OSError family covers flaky disks/NFS/network
+# (and chaos.TransientIOError subclasses it for tests). Anything else is a
+# programming error and propagates immediately.
+TRANSIENT_IO_ERRORS: Tuple[type, ...] = (OSError,)
 
 
 class ArrayDataset:
@@ -94,22 +101,134 @@ class BucketedDataset:
             yield from self.epoch()
 
 
-def prefetch(it: Iterator, depth: int = 2) -> Iterator:
+class EpochStream:
+    """Resumable epoch-looping batch stream aligned to a global step.
+
+    The iterator-protocol twin of ``while True: yield from
+    ds.epoch(epoch_seed=seed + ep)``, written as a class so
+    :func:`prefetch`'s transient-IO retry actually works on the training
+    path: an error raised by the underlying dataset propagates to the
+    caller but leaves THIS iterator alive — the next ``__next__`` rebuilds
+    the (now-finalized) epoch iterator and fast-forwards to the failed
+    position, re-attempting the same batch. A generator here would be
+    finalized by the first raise, turning every retry into StopIteration
+    — i.e. a silent end of the infinite stream.
+
+    Alignment: construction at global step ``start_step`` positions the
+    stream exactly where an uninterrupted run would be — epoch
+    ``start_step // steps_per_epoch``, shuffled with ``seed + epoch``,
+    offset ``start_step % steps_per_epoch`` — the exact data-iterator
+    resume contract (SURVEY.md §5 checkpoint rebuild note). ``ds`` needs
+    ``steps_per_epoch`` and ``epoch(epoch_seed=...)``, which every
+    pipeline class provides.
+    """
+
+    def __init__(self, ds, seed: int, start_step: int = 0):
+        self._ds = ds
+        self._seed = int(seed)
+        self._epoch = start_step // ds.steps_per_epoch
+        self._pos = start_step % ds.steps_per_epoch  # next batch index
+        self._it: Optional[Iterator] = None
+        self._it_pos = 0            # batches consumed from the live _it
+
+    def __iter__(self) -> "EpochStream":
+        return self
+
+    def __next__(self):
+        while True:
+            if self._it is None:
+                self._it = self._ds.epoch(
+                    epoch_seed=self._seed + self._epoch)
+                self._it_pos = 0
+            try:
+                # steady state runs this loop once (_it_pos == _pos); after
+                # an error or a resume it replays the deterministic epoch
+                # up to the target position first
+                while True:
+                    batch = next(self._it)
+                    self._it_pos += 1
+                    if self._it_pos > self._pos:
+                        break
+            except StopIteration:
+                self._epoch += 1
+                self._pos = 0
+                self._it = None
+                continue
+            except BaseException:
+                # the raise finalized the underlying epoch generator; drop
+                # it so the next attempt (prefetch retry) rebuilds and
+                # fast-forwards back to this same position
+                self._it = None
+                raise
+            self._pos += 1
+            return batch
+
+
+def prefetch(it: Iterator, depth: int = 2, max_retries: int = 0,
+             backoff_s: float = 0.05, max_backoff_s: float = 2.0,
+             on_event: Optional[Callable[[dict], None]] = None) -> Iterator:
     """Run ``it`` in a daemon thread, keeping ``depth`` batches ready.
 
     Overlaps host batch prep with device compute — the role of the
     reference's DataLoader workers, one thread being plenty for these
     workloads.
+
+    ``max_retries`` > 0 adds transient-fault tolerance: a pull that raises
+    one of :data:`TRANSIENT_IO_ERRORS` is retried up to ``max_retries``
+    times with bounded exponential backoff (``backoff_s * 2**attempt``,
+    capped at ``max_backoff_s``), then propagates. Retry needs a
+    *resumable* source (a class-based iterator such as :class:`EpochStream`
+    — the Trainer's production stream); a generator is finalized by its
+    first raise, so its retries hit StopIteration — that StopIteration is
+    recognized (the pull DID fail) and the original transient error is
+    re-raised instead of silently ending the stream. Each attempt emits an
+    ``{"event": "io_retry", ...}`` record through ``on_event`` (the
+    Trainer wires this to its JSONL metrics stream); ``on_event`` runs on
+    the prefetch thread, so the sink must be thread-safe
+    (metrics.JSONLWriter is).
     """
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     _END = object()
     _ERR = object()
 
+    def pull(src: Iterator):
+        attempt = 0
+        last_err: Optional[BaseException] = None
+        while True:
+            try:
+                return next(src)
+            except StopIteration:
+                if last_err is not None:
+                    # a generator source was finalized by the transient
+                    # error it raised; its "end" IS the failure — re-raise
+                    # the real cause instead of letting the infinite
+                    # stream silently end as a clean StopIteration
+                    raise last_err
+                raise
+            except TRANSIENT_IO_ERRORS as e:
+                last_err = e
+                attempt += 1
+                if attempt > max_retries:
+                    raise
+                delay = min(backoff_s * (2.0 ** (attempt - 1)),
+                            max_backoff_s)
+                if on_event is not None:
+                    on_event({"event": "io_retry", "attempt": attempt,
+                              "max_retries": max_retries,
+                              "backoff_s": round(delay, 6),
+                              "error": repr(e)})
+                time.sleep(delay)
+
     def worker():
         try:
-            for item in it:
+            src = iter(it)
+            while True:
+                try:
+                    item = pull(src)
+                except StopIteration:
+                    q.put(_END)
+                    return
                 q.put(item)
-            q.put(_END)
         except BaseException as e:  # noqa: BLE001 — re-raised in consumer
             q.put((_ERR, e))
 

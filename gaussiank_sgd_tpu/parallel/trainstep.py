@@ -103,6 +103,14 @@ class StepMetrics(NamedTuple):
                               # negative past a ~500M-param dense payload
                               # (VERDICT r3 weak #5) — exact below 16 MB,
                               # <1e-7 relative above
+    skipped: jax.Array        # float32 0/1: the in-step non-finite guard
+                              # turned this step into a no-op (params,
+                              # opt_state, ef_residual, carry, comp_state
+                              # all unchanged); step still advances
+    nonfinite: jax.Array      # float32: global count of non-finite grad
+                              # entries this step (+1 if the loss itself is
+                              # non-finite); 0 on clean steps and when the
+                              # guard is disabled
 
 
 # loss_fn(params, model_state, batch, rng)
@@ -314,6 +322,7 @@ def build_dp_train_step(
     recurrent: bool = False,
     sp_axis: Optional[str] = None,
     flat_opt: Optional[FlatSGDM] = None,
+    guard_nonfinite: bool = True,
 ) -> DPTrainStep:
     """Build the data-parallel train step over ``mesh``.
 
@@ -337,6 +346,19 @@ def build_dp_train_step(
     LossFn) and ``TrainState.carry`` holds batch-dim-sharded hidden state
     that persists across steps — the reference's bptt "repackaging"
     (SURVEY.md §3.2). Pass the initial carry to ``init_state``.
+
+    ``guard_nonfinite``: fuse a non-finite anomaly guard into both step
+    programs (training/resilience.py is the host half). The local grads'
+    non-finite entry count is psum'd over the mesh so EVERY worker agrees,
+    and an anomalous step commits the OLD state through elementwise
+    ``jnp.where`` selects — no ``lax.cond`` (whose branches diverge under
+    shard_map batching) and no host sync; the step counter AND the integer
+    (counter) leaves of opt_state still advance so the LR schedule and
+    data stream stay aligned on every optimizer path. Containment must be
+    in-step because a NaN that reaches ``ef_residual`` is re-sent by error
+    feedback on every later step. Cost: one ``isfinite`` pass over the
+    grads + one select pass over params/opt_state/residual, both
+    elementwise and fused by XLA (<2% of a step; bench via benchlib).
 
     ``sp_axis``: ring-attention sequence parallelism (long-context path).
     Must name the mesh's LAST axis; the batch's dim 0 then shards over the
@@ -411,6 +433,42 @@ def build_dp_train_step(
                                       _linear_device_index())
         comp_rng = jax.random.fold_in(base, 1)
         return data_rng, comp_rng
+
+    def _guard_count(loss: jax.Array, flat_g: jax.Array) -> jax.Array:
+        """Global non-finite count: per-worker grad-entry count psum'd over
+        every mesh axis (all workers must agree — one worker's NaN pollutes
+        the summed exchange for everyone), plus one for a non-finite loss
+        (already dp-mean'd, so globally consistent)."""
+        cnt = jnp.sum((~jnp.isfinite(flat_g)).astype(jnp.int32))
+        for a in axes:
+            cnt = lax.psum(cnt, a)
+        return cnt + (~jnp.isfinite(loss)).astype(jnp.int32)
+
+    def _guard_commit(ok: jax.Array, old: TrainState,
+                      new: TrainState) -> TrainState:
+        """Commit ``new`` when ``ok``, else keep ``old``'s training state
+        bit-identically — elementwise ``jnp.where`` on a replicated scalar
+        predicate, so there is no branch divergence and no host sync. The
+        step counter and rng always come from ``new`` (a skipped step still
+        advances the schedule/data position), and so do the INTEGER leaves
+        of opt_state: they are step/schedule counters (optax
+        ScaleByScheduleState.count and kin) whose value must track
+        state.step — guarding them would make the optax-path LR schedule
+        lag the global step by one per skip. Counter increments never
+        touch the gradient, so a NaN cannot leak through them; float
+        leaves (momentum/trace buffers) are guarded."""
+        def keep(n, o):
+            return jax.tree.map(lambda a, b: jnp.where(ok, a, b), n, o)
+        def keep_opt(n, o):
+            return jax.tree.map(
+                lambda a, b: a if jnp.issubdtype(a.dtype, jnp.integer)
+                else jnp.where(ok, a, b), n, o)
+        return TrainState(new.step, keep(new.params, old.params),
+                          keep(new.model_state, old.model_state),
+                          keep_opt(new.opt_state, old.opt_state),
+                          keep(new.ef_residual, old.ef_residual),
+                          new.rng, keep(new.carry, old.carry),
+                          keep(new.comp_state, old.comp_state))
 
     def _local_grads(state: TrainState, batch: Any, data_rng: jax.Array):
         loss, mstate, aux, new_carry, grads = _microbatch_grads(
@@ -517,9 +575,17 @@ def build_dp_train_step(
             new_state = _apply(state, mstate, dense, unravel, residual,
                                new_carry,
                                cstate[None, :] if spec.stateful else ())
+        if guard_nonfinite:
+            cnt = _guard_count(loss, flat_g)
+            new_state = _guard_commit(cnt == 0, state, new_state)
+            skipped = (cnt > 0).astype(jnp.float32)
+            nonfinite = cnt.astype(jnp.float32)
+        else:
+            skipped = nonfinite = jnp.float32(0)
         return new_state, StepMetrics(
             loss, aux, _pmean(jnp.linalg.norm(flat_g)),
-            _pmean(nsel.astype(jnp.float32)), bytes_sent)
+            _pmean(nsel.astype(jnp.float32)), bytes_sent, skipped,
+            nonfinite)
 
     def dense_step_fn(state: TrainState, batch: Any):
         data_rng, _ = _step_rngs(state)
@@ -541,9 +607,17 @@ def build_dp_train_step(
         else:
             new_state = _apply(state, mstate, dense, unravel,
                                state.ef_residual, new_carry)
+        if guard_nonfinite:
+            cnt = _guard_count(loss, flat_g)
+            new_state = _guard_commit(cnt == 0, state, new_state)
+            skipped = (cnt > 0).astype(jnp.float32)
+            nonfinite = cnt.astype(jnp.float32)
+        else:
+            skipped = nonfinite = jnp.float32(0)
         return new_state, StepMetrics(
             loss, aux, _pmean(jnp.linalg.norm(flat_g)),
-            jnp.float32(n_total), jnp.float32(n_total * 4))
+            jnp.float32(n_total), jnp.float32(n_total * 4), skipped,
+            nonfinite)
 
     if sp_axis is None:
         batch_spec = P(axes)        # leading dim sharded over every dp axis

@@ -1,0 +1,214 @@
+"""Deterministic fault injection — the test harness for the resilience
+runtime (tests/test_resilience.py drives every recovery path with it).
+
+Three fault families, mirroring the failure model in docs/RESILIENCE.md:
+
+* **non-finite gradients** — :func:`inject_nan_batches` wraps a Trainer's
+  batch stream so the batch feeding a configured global step is NaN
+  -poisoned; the model's backward pass then produces non-finite grads
+  naturally, exactly like an overflow/bad-record would, and the in-step
+  guard (parallel/trainstep.py) must contain it;
+* **checkpoint corruption** — :func:`corrupt_checkpoint` truncates,
+  garbage-fills, or unseals a saved checkpoint dir, the three on-disk
+  states a preempted/bit-rotted save can leave behind
+  (training/checkpoint.py must skip or fall back);
+* **transient loader errors** — :class:`FlakyIterator` raises
+  :class:`TransientIOError` on configured pulls while staying resumable
+  (unit-level injection against ``data_lib.prefetch``), and
+  :class:`FlakyEpochSource` raises from inside a dataset's ``epoch``
+  generator (production-path injection: through the Trainer's own
+  ``_stream`` → ``EpochStream`` → ``prefetch`` wiring).
+
+Everything is keyed on explicit step/pull indices — no randomness — so a
+chaos test failure reproduces bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Iterator, Sequence, Set
+
+import numpy as np
+
+from .checkpoint import MANIFEST
+
+
+class TransientIOError(OSError):
+    """The injected 'flaky disk/network' error; an OSError subclass so
+    production retry logic (data/loader.py TRANSIENT_IO_ERRORS) treats it
+    exactly like the real thing."""
+
+
+def poison_batch(batch, fill: float = float("nan")):
+    """Return ``batch`` with every float leaf replaced by ``fill``.
+
+    Integer leaves (labels, token ids) pass through — NaN has no integer
+    encoding, and grads go non-finite from the poisoned inputs alone. A
+    batch with no float leaf cannot carry the fault; fail loud rather
+    than silently injecting nothing.
+    """
+    out, hit = [], False
+    for a in batch:
+        a = np.asarray(a)
+        if np.issubdtype(a.dtype, np.floating):
+            out.append(np.full_like(a, fill))
+            hit = True
+        else:
+            out.append(a)
+    if not hit:
+        raise ValueError(
+            "poison_batch: no float leaf in batch — NaN injection needs a "
+            "float input (use a float-input model for chaos tests)")
+    return tuple(out)
+
+
+def inject_nan_batches(trainer, steps: Iterable[int], once: bool = True,
+                       fill: float = float("nan")) -> Set[int]:
+    """Poison the batch feeding each global step in ``steps``.
+
+    Wraps ``trainer._stream`` (the epoch stream already realigns itself to
+    ``trainer.step``, so the wrapper keys on *global* step index and stays
+    correct across rollback/restore-triggered stream rebuilds). With
+    ``once=True`` (default) each listed step is poisoned only the first
+    time it is fed — a rolled-back run replays it clean, modelling a
+    transient bad record; ``once=False`` re-poisons on replay, modelling a
+    persistently corrupt shard (drives the rollback-budget path).
+
+    Returns the live ``fired`` set (which steps have been poisoned so far)
+    for test assertions.
+    """
+    steps = set(int(s) for s in steps)
+    fired: Set[int] = set()
+    orig = trainer._stream
+
+    class _PoisonedStream:
+        """Class-based (resumable) wrapper: a transient IO error raised by
+        the wrapped stream passes through WITHOUT finalizing this object,
+        so prefetch retry keeps working under NaN injection (a generator
+        wrapper would undo the resumable production stream)."""
+
+        def __init__(self):
+            self._inner = orig()
+            self._step = trainer.step
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            batch = next(self._inner)   # may raise + be retried; _step
+            s = self._step              # only advances on success
+            self._step += 1
+            if s in steps and (not once or s not in fired):
+                fired.add(s)
+                return poison_batch(batch, fill)
+            return batch
+
+    trainer._stream = _PoisonedStream
+    trainer._invalidate_data_iter()
+    return fired
+
+
+class FlakyIterator:
+    """Resumable iterator that raises :class:`TransientIOError` on
+    configured pulls. Pull ``n`` (0-based count of ``__next__`` calls that
+    would return an item) fails ``failures_per_pull`` times before the
+    underlying item comes through — the retrying consumer must call
+    ``next`` again, and unlike a generator this object survives the raise.
+    """
+
+    def __init__(self, it: Iterator, fail_pulls: Sequence[int],
+                 failures_per_pull: int = 1):
+        self._it = iter(it)
+        self._remaining = {int(p): int(failures_per_pull)
+                           for p in fail_pulls}
+        self._pull = 0
+        self.raised = 0
+
+    def __iter__(self) -> "FlakyIterator":
+        return self
+
+    def __next__(self):
+        left = self._remaining.get(self._pull, 0)
+        if left > 0:
+            self._remaining[self._pull] = left - 1
+            self.raised += 1
+            raise TransientIOError(
+                f"injected transient failure (pull {self._pull}, "
+                f"{left - 1} more)")
+        item = next(self._it)
+        self._pull += 1
+        return item
+
+
+class FlakyEpochSource:
+    """Dataset wrapper whose ``epoch`` generator raises
+    :class:`TransientIOError` instead of yielding configured batch
+    indices (the first ``times`` requests each) — the *production-path*
+    injector for prefetch retry: assign it to ``trainer.train_ds`` and
+    the fault surfaces inside the Trainer's own ``_stream``/``prefetch``
+    wiring. The raise finalizes the epoch generator exactly like a real
+    flaky read would, so only a resumable consumer
+    (``data_lib.EpochStream``) survives it; replays after a retry are
+    deterministic because ``epoch_seed`` re-creates the same order.
+    """
+
+    def __init__(self, ds, fail_batches: Sequence[int], times: int = 1):
+        self._ds = ds
+        self._remaining = {int(b): int(times) for b in fail_batches}
+        self.raised = 0
+
+    def __getattr__(self, name):        # steps_per_epoch, batch_size, ...
+        return getattr(self._ds, name)
+
+    def epoch(self, epoch_seed=None):
+        for i, batch in enumerate(self._ds.epoch(epoch_seed=epoch_seed)):
+            if self._remaining.get(i, 0) > 0:
+                self._remaining[i] -= 1
+                self.raised += 1
+                raise TransientIOError(
+                    f"injected flaky read (epoch batch {i}, "
+                    f"{self._remaining[i]} more)")
+            yield batch
+
+
+def corrupt_checkpoint(path: str, mode: str = "truncate") -> str:
+    """Deterministically damage a saved checkpoint dir.
+
+    * ``'truncate'`` — halve the largest inventoried file: the commit
+      manifest's size check fails, so ``latest_checkpoint`` must skip the
+      dir entirely (the aborted-mid-write case);
+    * ``'garbage'`` — overwrite every file (except the manifest) with
+      same-size 0xFF bytes: the dir still LOOKS sealed and valid, so the
+      failure only surfaces when orbax tries to restore it —
+      ``restore_latest_good`` must fall back to the previous checkpoint
+      (the bit-rot / torn-write case);
+    * ``'unseal'`` — delete the commit manifest: the dir is
+      indistinguishable from a save that never finished (the
+      preempted-before-commit case).
+
+    Returns the path damaged (for chaining into asserts).
+    """
+    if mode not in ("truncate", "garbage", "unseal"):
+        raise ValueError(f"unknown corruption mode {mode!r} "
+                         "(truncate|garbage|unseal)")
+    if mode == "unseal":
+        os.remove(os.path.join(path, MANIFEST))
+        return path
+    files = []
+    for root, _dirs, names in os.walk(path):
+        for n in names:
+            if n != MANIFEST:
+                files.append(os.path.join(root, n))
+    if not files:
+        raise ValueError(f"nothing to corrupt under {path!r}")
+    if mode == "truncate":
+        victim = max(files, key=os.path.getsize)
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.truncate(max(size // 2, 1) if size > 1 else 0)
+        return path
+    for fp in files:
+        size = os.path.getsize(fp)
+        with open(fp, "r+b") as f:
+            f.write(b"\xff" * size)
+    return path

@@ -7,12 +7,27 @@ residuals** (un-sent gradient mass is training state; the reference likely
 drops it), model_state (BatchNorm stats), PRNG key, and the step counter —
 so resume is exact; the trainer separately realigns its data stream to the
 restored step (``Trainer._stream``: epoch-seeded shuffle + in-epoch skip).
+
+Failure model (SURVEY.md §5 "Failure detection"; docs/RESILIENCE.md): a
+save interrupted by preemption or a crash must never poison a later
+resume. Every completed save is sealed with a **commit manifest**
+(``commit_manifest.json`` inside the step dir) carrying a file inventory
+with sizes; ``latest_checkpoint`` only returns sealed, inventory-valid
+dirs (aborted orbax tmp dirs and manifest-less or truncated dirs are
+skipped), and ``restore_latest_good`` walks backwards through the sealed
+checkpoints until one actually restores — a corrupted-but-sealed dir
+(bit rot, chaos injection) falls back to the previous good one.
+``gc_checkpoints`` implements keep-last-k retention so long runs with a
+step-cadence save don't fill the disk.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Optional
+import shutil
+import time
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +35,11 @@ import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.trainstep import TrainState
+
+# sealed-save marker, written LAST (atomic rename) after orbax finishes;
+# its presence is the commit bit, its inventory the cheap integrity check
+MANIFEST = "commit_manifest.json"
+_ORBAX_TMP_MARKER = "orbax-checkpoint-tmp"
 
 
 def _dp_width(state: TrainState) -> Optional[int]:
@@ -33,7 +53,8 @@ def _dp_width(state: TrainState) -> Optional[int]:
 
 
 def save_checkpoint(ckpt_dir: str, state: TrainState,
-                    num_workers: Optional[int] = None) -> str:
+                    num_workers: Optional[int] = None,
+                    overwrite: bool = False) -> str:
     """Write a checkpoint for the current step; returns its path.
 
     The live ``ef_residual`` is flat ``[P*N]`` (layout, see TrainState
@@ -47,14 +68,25 @@ def save_checkpoint(ckpt_dir: str, state: TrainState,
     blocks stay put), so orbax still saves a sharded array — no host
     gather (which would also break non-fully-addressable DCN meshes).
 
-    Idempotent per step: a checkpoint that already exists for this step is
-    left in place (covers epoch-boundary + final-save landing on the same
-    step, and reruns over an existing run dir).
+    Idempotent per step by default: a SEALED checkpoint that already
+    exists for this step is left in place (covers epoch-boundary +
+    final-save landing on the same step). ``overwrite=True`` replaces
+    even a sealed dir — callers pass it when the live state may DIFFER
+    from what that dir holds: a run resumed from an explicitly-given
+    older checkpoint, or a post-rollback replay with a backed-off LR,
+    re-reaches steps the old trajectory already sealed, and silently
+    keeping the stale dirs would hand a later resume/rollback the wrong
+    state (the Trainer tracks this per trajectory). An existing but
+    unsealed dir at this step is a previous aborted save — it is always
+    removed and rewritten, so a preempted run that retries the same step
+    heals the partial artifact instead of trusting it.
     """
     step = int(jax.device_get(state.step))
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
     if os.path.exists(path):
-        return path
+        if is_committed(path) and not overwrite:
+            return path
+        shutil.rmtree(path)
     p = num_workers or _dp_width(state)
     if not p:
         raise ValueError(
@@ -76,16 +108,91 @@ def save_checkpoint(ckpt_dir: str, state: TrainState,
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, state._replace(ef_residual=ef))
     ckptr.wait_until_finished()
+    _write_manifest(path, step)
     return path
 
 
-def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+def _write_manifest(path: str, step: int) -> None:
+    """Seal a finished save: inventory every file (relpath -> size), write
+    the manifest to a tmp name, rename into place. The rename is the commit
+    point — a crash anywhere before it leaves a dir that
+    ``latest_checkpoint`` ignores."""
+    inv = {}
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            if f == MANIFEST:
+                continue
+            fp = os.path.join(root, f)
+            inv[os.path.relpath(fp, path)] = os.path.getsize(fp)
+    tmp = os.path.join(path, MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump({"format": 1, "step": step, "wrote_unix": time.time(),
+                   "files": inv}, f)
+    os.replace(tmp, os.path.join(path, MANIFEST))
+
+
+def is_committed(path: str) -> bool:
+    """True iff ``path`` is a sealed checkpoint whose file inventory still
+    matches on disk (names AND sizes) — catches aborted saves (no manifest)
+    and truncation/deletion corruption; same-size bit rot is caught later
+    by ``restore_latest_good``'s restore-and-fall-back."""
+    mf = os.path.join(path, MANIFEST)
+    if not os.path.isfile(mf):
+        return False
+    try:
+        with open(mf) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (OSError, ValueError, KeyError):
+        return False
+    for rel, size in files.items():
+        fp = os.path.join(path, rel)
+        if not os.path.isfile(fp) or os.path.getsize(fp) != int(size):
+            return False
+    return True
+
+
+def list_checkpoints(ckpt_dir: str) -> List[Tuple[int, str]]:
+    """Sealed, inventory-valid checkpoints as (step, path), ascending.
+    Orbax tmp dirs (in-flight/aborted atomic saves) and unsealed or
+    size-mismatched dirs are excluded — they must never be resume
+    candidates (ISSUE: an aborted ``step_XXXXXXXX`` dir poisons resume)."""
     if not os.path.isdir(ckpt_dir):
-        return None
-    steps = [d for d in os.listdir(ckpt_dir) if d.startswith("step_")]
-    if not steps:
-        return None
-    return os.path.join(os.path.abspath(ckpt_dir), sorted(steps)[-1])
+        return []
+    out = []
+    for d in sorted(os.listdir(ckpt_dir)):
+        if not d.startswith("step_") or _ORBAX_TMP_MARKER in d:
+            continue
+        path = os.path.join(os.path.abspath(ckpt_dir), d)
+        if not os.path.isdir(path) or not is_committed(path):
+            continue
+        try:
+            step = int(d[len("step_"):])
+        except ValueError:
+            continue
+        out.append((step, path))
+    return out
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    ckpts = list_checkpoints(ckpt_dir)
+    return ckpts[-1][1] if ckpts else None
+
+
+def gc_checkpoints(ckpt_dir: str, keep_last: int) -> List[str]:
+    """Keep-last-k retention: delete all but the newest ``keep_last``
+    sealed checkpoints. Unsealed/tmp dirs are left alone (an in-flight
+    save must not be raced; aborted ones are healed by the next save at
+    that step). Returns the removed paths. ``keep_last < 1`` is a no-op —
+    retention off."""
+    if keep_last < 1:
+        return []
+    ckpts = list_checkpoints(ckpt_dir)
+    removed = []
+    for _step, path in ckpts[:-keep_last]:
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+    return removed
 
 
 def restore_checkpoint(path: str, target: TrainState,
@@ -292,4 +399,63 @@ def restore_checkpoint(path: str, target: TrainState,
                 lambda x: jax.device_put(x, dp_sh), comp_state)
         restored = restored._replace(ef_residual=ef, carry=carry,
                                      comp_state=comp_state)
+    # Re-materialize every leaf through a jitted identity. Orbax hands back
+    # arrays whose buffers tensorstore owns; the fused train step DONATES
+    # its input state, and donating memory XLA's allocator does not own
+    # corrupts the heap (observed: glibc "corrupted double-linked list"
+    # aborts on the first steps after an in-process rollback/restore). The
+    # copy pins the whole state in XLA-owned buffers for one state-sized
+    # copy per restore — noise next to the restore's own IO.
+    restored = jax.jit(lambda s: s)(restored)
+    jax.block_until_ready(restored)
     return restored
+
+
+def restore_latest_good(ckpt_dir: str, target: TrainState,
+                        mesh: Optional[Mesh] = None,
+                        on_skip=None,
+                        before_step: Optional[int] = None
+                        ) -> Tuple[TrainState, str]:
+    """Restore the newest checkpoint that actually restores.
+
+    Walks the sealed checkpoints newest-first; a candidate that fails to
+    restore (sealed but corrupted — garbage bytes at the right sizes, a
+    mangled orbax metadata file, ...) is skipped and the previous one is
+    tried (``on_skip(path, exc)`` is called per skip, for logging).
+    ``before_step`` restricts candidates to checkpoints strictly older
+    than the given step — divergence rollback passes the step the anomaly
+    was first observed at, so a checkpoint sealed at/after it (which
+    already holds the diverged state) is never the rollback target.
+    Returns ``(state, path)``; raises ``FileNotFoundError`` when no
+    eligible sealed checkpoint exists and ``RuntimeError`` when every
+    candidate failed.
+
+    The broad ``except Exception`` is deliberate: corruption surfaces as
+    whatever orbax/zarr/json error the damaged byte happened to hit, and
+    the whole point of this function is to survive all of them. Structural
+    mismatches (different model, flat-opt vs optax) raise the same way and
+    also fall through — the final RuntimeError carries every per-candidate
+    cause so a genuine config error is still diagnosable.
+    """
+    ckpts = list_checkpoints(ckpt_dir)
+    if before_step is not None:
+        ckpts = [(s, p) for s, p in ckpts if s < before_step]
+    if not ckpts:
+        raise FileNotFoundError(
+            f"no committed checkpoint under {ckpt_dir!r}"
+            + (f" older than step {before_step}" if before_step is not None
+               else "")
+            + " (aborted/partial saves are skipped; see "
+            "docs/RESILIENCE.md)")
+    causes = []
+    for _step, path in reversed(ckpts):
+        try:
+            return restore_checkpoint(path, target, mesh), path
+        except Exception as e:  # noqa: BLE001 — see docstring
+            causes.append(f"{os.path.basename(path)}: {type(e).__name__}: "
+                          f"{e}")
+            if on_skip is not None:
+                on_skip(path, e)
+    raise RuntimeError(
+        "every committed checkpoint failed to restore:\n  "
+        + "\n  ".join(causes))

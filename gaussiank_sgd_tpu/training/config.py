@@ -69,6 +69,34 @@ class TrainConfig:
     compute_dtype: str = "bfloat16"         # MXU-native compute
     seed: int = 42
 
+    # resilience (docs/RESILIENCE.md; training/resilience.py)
+    nonfinite_guard: bool = True            # fused in-step anomaly guard:
+                                            # a non-finite loss/grad step
+                                            # commits nothing (params/opt/
+                                            # EF unchanged) and is flagged
+                                            # in metrics
+    max_consecutive_skips: int = 10         # rollback after N back-to-back
+                                            # guard-skipped steps (0 = off)
+    loss_spike_factor: float = 0.0          # rollback when loss > f * EMA
+                                            # (0 = off)
+    loss_ema_beta: float = 0.9              # spike-detector EMA decay
+    lr_backoff: float = 0.5                 # LR scale per rollback
+                                            # (compounds)
+    max_rollbacks: int = 3                  # then fail loud
+    save_every_steps: int = 0               # mid-epoch checkpoint cadence
+                                            # (0 = epoch saves only); the
+                                            # rollback target is the last
+                                            # such checkpoint
+    keep_checkpoints: int = 0               # keep-last-k retention GC
+                                            # (0 = keep all)
+    handle_signals: bool = True             # fit(): SIGTERM/SIGINT ->
+                                            # checkpoint at next step
+                                            # boundary, clean exit
+    io_retries: int = 3                     # transient data-loader errors
+                                            # retried per batch (0 = off)
+    io_backoff_s: float = 0.05              # initial retry backoff
+                                            # (exponential, capped at 2 s)
+
     # escape hatches for tests/experiments: extra ctor kwargs threaded
     # through to models.get_model / data.make_dataset (e.g. a toy LSTM:
     # model_kwargs={'hidden_dim': 64}, dataset_kwargs={'vocab_size': 256})
@@ -173,6 +201,41 @@ def add_args(p: argparse.ArgumentParser, suppress_defaults: bool = False) -> Non
     p.add_argument("--compute-dtype", dest="compute_dtype",
                    default=d.compute_dtype)
     p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--nonfinite-guard", dest="nonfinite_guard",
+                   action=argparse.BooleanOptionalAction,
+                   default=d.nonfinite_guard,
+                   help="fused in-step anomaly guard: non-finite steps "
+                        "commit nothing (docs/RESILIENCE.md)")
+    p.add_argument("--max-consecutive-skips", dest="max_consecutive_skips",
+                   type=int, default=d.max_consecutive_skips,
+                   help="rollback after N back-to-back skipped steps; 0=off")
+    p.add_argument("--loss-spike-factor", dest="loss_spike_factor",
+                   type=float, default=d.loss_spike_factor,
+                   help="rollback when loss > factor * EMA(loss); 0=off")
+    p.add_argument("--loss-ema-beta", dest="loss_ema_beta", type=float,
+                   default=d.loss_ema_beta)
+    p.add_argument("--lr-backoff", dest="lr_backoff", type=float,
+                   default=d.lr_backoff,
+                   help="LR scale applied per rollback (compounds)")
+    p.add_argument("--max-rollbacks", dest="max_rollbacks", type=int,
+                   default=d.max_rollbacks)
+    p.add_argument("--save-every-steps", dest="save_every_steps", type=int,
+                   default=d.save_every_steps,
+                   help="mid-epoch checkpoint cadence (rollback target); "
+                        "0 = epoch saves only")
+    p.add_argument("--keep-checkpoints", dest="keep_checkpoints", type=int,
+                   default=d.keep_checkpoints,
+                   help="keep-last-k checkpoint retention; 0 = keep all")
+    p.add_argument("--handle-signals", dest="handle_signals",
+                   action=argparse.BooleanOptionalAction,
+                   default=d.handle_signals,
+                   help="SIGTERM/SIGINT -> checkpoint at next step "
+                        "boundary, then clean exit")
+    p.add_argument("--io-retries", dest="io_retries", type=int,
+                   default=d.io_retries,
+                   help="transient data-loader error retries per batch")
+    p.add_argument("--io-backoff-s", dest="io_backoff_s", type=float,
+                   default=d.io_backoff_s)
     p.add_argument("--run-id", dest="run_id", default=d.run_id)
     p.add_argument("--output-dir", dest="output_dir", default=d.output_dir)
     p.add_argument("--log-every", dest="log_every", type=int,

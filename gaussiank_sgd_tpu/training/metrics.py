@@ -13,6 +13,7 @@ import json
 import logging
 import os
 import sys
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -38,23 +39,33 @@ def make_logger(name: str = "gaussiank_sgd_tpu",
 
 
 class JSONLWriter:
-    """Append-only JSONL metric stream (one dict per record)."""
+    """Append-only JSONL metric stream (one dict per record).
+
+    Thread-safe: the train loop writes from the main thread while the
+    prefetch thread reports ``io_retry`` events (data/loader.py), so the
+    dump+write pair is serialized under a lock — interleaved half-lines
+    would corrupt the stream for every downstream parser.
+    """
 
     def __init__(self, path: Optional[str]):
         self.path = path
         self._f = None
+        self._lock = threading.Lock()
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self._f = open(path, "a", buffering=1)
 
     def write(self, record: Dict[str, Any]) -> None:
-        if self._f:
-            self._f.write(json.dumps(record, default=float) + "\n")
+        line = json.dumps(record, default=float) + "\n"
+        with self._lock:
+            if self._f:
+                self._f.write(line)
 
     def close(self) -> None:
-        if self._f:
-            self._f.close()
-            self._f = None
+        with self._lock:
+            if self._f:
+                self._f.close()
+                self._f = None
 
 
 class PhaseTimers:

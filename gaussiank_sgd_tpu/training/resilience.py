@@ -1,0 +1,197 @@
+"""Host-side resilience runtime — the policy half of fault tolerance.
+
+SURVEY.md §5 "Failure detection": the reference dies (or silently
+diverges) on a non-finite gradient, a preempted host, or a half-written
+checkpoint. The rebuild splits containment across two layers:
+
+* **device side** (parallel/trainstep.py ``guard_nonfinite``): a fused
+  in-step guard turns a non-finite step into a no-op with no host sync —
+  the only place fast enough to keep a NaN out of ``ef_residual`` (error
+  feedback would re-send it forever);
+* **host side** (this module): a :class:`ResiliencePolicy` the Trainer
+  consults — per-step it *observes* (cheap scalar reads of metrics the
+  step already synced), per log interval it *acts*: a consecutive-skip
+  budget or a loss spike triggers rollback to the last good checkpoint
+  with LR backoff (training/checkpoint.py ``restore_latest_good``), and
+  :class:`GracefulShutdown` converts SIGTERM/SIGINT into a
+  checkpoint-at-the-next-step-boundary followed by a clean exit
+  (:class:`TrainingPreempted`).
+
+Nothing here touches jitted code; the monitor is plain Python state and
+is deterministic given the observed metric stream — which is what lets
+training/chaos.py drive every path in tests.
+"""
+
+from __future__ import annotations
+
+import math
+import signal
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+
+class TrainingPreempted(Exception):
+    """Raised at a step boundary after a shutdown request was honored
+    (checkpoint written). Carries the step the run stopped at."""
+
+    def __init__(self, step: int, ckpt_path: Optional[str]):
+        super().__init__(f"preempted at step {step}, checkpoint: "
+                         f"{ckpt_path or 'none'}")
+        self.step = step
+        self.ckpt_path = ckpt_path
+
+
+@dataclass
+class ResiliencePolicy:
+    """Knobs for the host-side monitor (TrainConfig carries the same
+    fields; 0 disables the corresponding detector).
+
+    ``max_consecutive_skips``: after this many back-to-back guard-skipped
+    steps, the run rolls back — persistent non-finites mean the live
+    params/data are beyond what step-skipping can ride out.
+
+    ``loss_spike_factor``: rollback when a logged loss exceeds
+    ``factor * EMA(loss)`` (EMA over finite observed losses,
+    ``loss_ema_beta`` decay, warmed for ``loss_ema_warmup`` observations
+    first). Catches divergence the non-finite guard can't see.
+
+    ``lr_backoff``: every rollback multiplies the LR scale by this factor
+    (compounding), so a run that keeps diverging descends to a step size
+    it can survive. ``max_rollbacks`` bounds the retries — beyond it the
+    run fails loud instead of looping forever on a poisoned input.
+    """
+
+    max_consecutive_skips: int = 10
+    loss_spike_factor: float = 0.0
+    loss_ema_beta: float = 0.9
+    loss_ema_warmup: int = 5
+    lr_backoff: float = 0.5
+    max_rollbacks: int = 3
+
+    @property
+    def active(self) -> bool:
+        return self.max_consecutive_skips > 0 or self.loss_spike_factor > 0
+
+
+class ResilienceMonitor:
+    """Per-run divergence tracker. ``observe`` is called once per step with
+    already-synced host scalars; ``should_rollback`` is consulted once per
+    log interval (ISSUE contract) and returns a reason string or None."""
+
+    def __init__(self, policy: ResiliencePolicy):
+        self.policy = policy
+        self.consecutive_skips = 0
+        self.total_skips = 0
+        self.rollbacks = 0
+        self._loss_ema: Optional[float] = None
+        self._ema_obs = 0
+        self._pending: Optional[str] = None
+        self._pending_step: Optional[int] = None
+
+    def _set_pending(self, reason: str, step: int) -> None:
+        if self._pending is None:
+            self._pending = reason
+            self._pending_step = step
+
+    def observe(self, step: int, loss: float, skipped: float) -> None:
+        p = self.policy
+        if skipped > 0:
+            self.consecutive_skips += 1
+            self.total_skips += 1
+            if (p.max_consecutive_skips > 0
+                    and self.consecutive_skips >= p.max_consecutive_skips):
+                self._set_pending("skip_budget", step)
+            return
+        self.consecutive_skips = 0
+        if not math.isfinite(loss):
+            # a non-finite loss on an unskipped step means the guard is off;
+            # treat it as a spike so the policy still has a detector
+            if p.loss_spike_factor > 0:
+                self._set_pending("loss_spike", step)
+            return
+        if p.loss_spike_factor > 0 and self._ema_obs >= p.loss_ema_warmup \
+                and self._loss_ema is not None \
+                and loss > p.loss_spike_factor * self._loss_ema:
+            self._set_pending("loss_spike", step)
+            return  # a spiking loss must not drag the EMA up after it
+        if self._loss_ema is None:
+            self._loss_ema = loss
+        else:
+            b = p.loss_ema_beta
+            self._loss_ema = b * self._loss_ema + (1.0 - b) * loss
+        self._ema_obs += 1
+
+    def should_rollback(self) -> Optional[str]:
+        return self._pending
+
+    @property
+    def pending_since(self) -> Optional[int]:
+        """Step at which the pending anomaly was first observed (None when
+        no rollback is pending). The rollback uses it to exclude
+        checkpoints sealed at or after the anomaly — the newest sealed
+        checkpoint may already hold the diverged state it is trying to
+        escape."""
+        return self._pending_step
+
+    def note_rollback(self) -> int:
+        """Account one executed rollback; returns its ordinal (1-based).
+        Raises when the rollback budget is exhausted — at that point the
+        run is looping on a fault rollback cannot fix."""
+        self.rollbacks += 1
+        if self.rollbacks > self.policy.max_rollbacks:
+            raise RuntimeError(
+                f"rollback budget exhausted ({self.policy.max_rollbacks}); "
+                f"the fault recurs after every restore — inspect the data "
+                f"pipeline / reduce lr (docs/RESILIENCE.md)")
+        # a restored run starts clean: skip streak, spike flag, and the
+        # loss EMA (post-rollback losses rebuild their own baseline)
+        self.consecutive_skips = 0
+        self._pending = None
+        self._pending_step = None
+        self._loss_ema = None
+        self._ema_obs = 0
+        return self.rollbacks
+
+    @property
+    def lr_scale(self) -> float:
+        """Compounded LR backoff after the rollbacks so far."""
+        return self.policy.lr_backoff ** self.rollbacks
+
+
+class GracefulShutdown:
+    """SIGTERM/SIGINT -> 'checkpoint at the next step boundary, then exit
+    cleanly'. The handler only flips a flag (async-signal-safe); the
+    trainer polls ``requested`` once per completed step. ``request()`` is
+    the programmatic equivalent (tests, schedulers). Thread-safe: the flag
+    is an Event, and ``install``/``uninstall`` must run on the main thread
+    (CPython restriction on ``signal.signal``)."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self) -> None:
+        self._flag = threading.Event()
+        self._old: dict = {}
+
+    def install(self) -> "GracefulShutdown":
+        for sig in self.SIGNALS:
+            self._old[sig] = signal.signal(sig, self._handler)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, old in self._old.items():
+            signal.signal(sig, old)
+        self._old.clear()
+
+    def _handler(self, signum, frame) -> None:
+        if self._flag.is_set() and signum == signal.SIGINT:
+            # second Ctrl-C: the user wants OUT, not another checkpoint
+            raise KeyboardInterrupt
+        self._flag.set()
+
+    def request(self) -> None:
+        self._flag.set()
+
+    @property
+    def requested(self) -> bool:
+        return self._flag.is_set()

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import math
 import os
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -38,12 +39,14 @@ from ..parallel.bucketing import plan_for_params
 from ..parallel.mesh import (batch_sharded, data_parallel_mesh, dp_sp_mesh,
                              hierarchical_dp_mesh, shard_batch)
 from ..parallel.trainstep import build_dp_train_step
-from .checkpoint import (latest_checkpoint, restore_checkpoint,
-                         save_checkpoint)
+from .checkpoint import (gc_checkpoints, restore_checkpoint,
+                         restore_latest_good, save_checkpoint)
 from .config import TrainConfig
 from .losses import make_eval_fn, make_loss_fn
 from .lr_schedule import warmup_milestone_schedule
 from .metrics import JSONLWriter, PhaseTimers, make_logger
+from .resilience import (GracefulShutdown, ResilienceMonitor,
+                         ResiliencePolicy, TrainingPreempted)
 
 
 def _dtype_of(name: str):
@@ -144,10 +147,121 @@ class Trainer:
         n_params = sum(int(np.prod(x.shape))
                        for x in jax.tree_util.tree_leaves(params))
 
-        # ---- schedule + inner optimizer (torch-SGD-equivalent chain) ----
-        self.schedule = warmup_milestone_schedule(
+        # ---- compression plan + loss fn (static across step rebuilds) ----
+        # LSTM bptt carry across windows (the reference's "repackaging",
+        # SURVEY.md §3.2): hidden state lives in TrainState.carry,
+        # batch-dim sharded; reset at epoch boundaries (train loop).
+        self.recurrent = (cfg.dnn.lower() == "lstm" and cfg.carry_hidden)
+        comp = get_compressor(cfg.compressor, density=cfg.density,
+                              sigma_scale=cfg.sigma_scale)
+        plan = plan_for_params(params, cfg.density, cfg.bucket_size,
+                               policy=cfg.bucket_policy)
+        self.plan = plan
+        self._comp = comp
+        # uint8 pixel batches (imagenet contract) normalize ON DEVICE —
+        # the dtype check inside _prep_pixels is trace-time static, so
+        # float batches pay nothing
+        from .losses import IMAGENET_NORM
+        input_norm = (IMAGENET_NORM if cfg.dataset.lower() == "imagenet"
+                      else None)
+        self._loss_fn = make_loss_fn(self.spec, cfg.label_smoothing,
+                                     recurrent=self.recurrent,
+                                     input_norm=input_norm)
+        self.is_dense_only = comp.name == "none"
+
+        # ---- schedule + optimizer + the fused step programs ----
+        self._lr_scale = 1.0            # compounded rollback LR backoff
+        self._build_steps()
+        carry = (self.spec.module.initial_carry(local_bs)
+                 if self.recurrent else ())
+        self.state = self.ts.init_state(params, state_rng,
+                                        model_state=model_state, carry=carry)
+
+        # ---- resilience runtime (docs/RESILIENCE.md) ----
+        self.ckpt_dir = os.path.join(run_dir, "ckpt")
+        self.shutdown = GracefulShutdown()   # handlers installed in fit()
+        policy = ResiliencePolicy(
+            max_consecutive_skips=(cfg.max_consecutive_skips
+                                   if cfg.nonfinite_guard else 0),
+            loss_spike_factor=cfg.loss_spike_factor,
+            loss_ema_beta=cfg.loss_ema_beta,
+            lr_backoff=cfg.lr_backoff,
+            max_rollbacks=cfg.max_rollbacks)
+        self.monitor = ResilienceMonitor(policy) if policy.active else None
+
+        # ---- eval step: shard_map'd sum-reduce over dp ----
+        eval_fn = make_eval_fn(self.spec, recurrent=self.recurrent,
+                               input_norm=input_norm)
+        axes = tuple(self.mesh.axis_names)
+        self._eval_bs = eval_bs
+
+        def eval_step(params, mstate, batch, *carry):
+            if self.recurrent:
+                sums, new_carry = eval_fn(params, mstate, batch, carry[0])
+            else:
+                sums, new_carry = eval_fn(params, mstate, batch), None
+            sums = jax.tree.map(lambda x: jax.lax.psum(x, axes), sums)
+            return (sums, new_carry) if self.recurrent else sums
+
+        batch_in = self._batch_spec if self.sp else P(axes)
+        in_specs = (P(), P(), batch_in) + ((P(axes),) if self.recurrent
+                                           else ())
+        out_specs = (P(), P(axes)) if self.recurrent else P()
+        self.eval_step = jax.jit(shard_map(
+            eval_step, mesh=self.mesh,
+            in_specs=in_specs, out_specs=out_specs, check_vma=False))
+
+        # ---- resume ----
+        # a dir resumes from the newest restorable checkpoint (sealed-only
+        # listing + corrupt-fallback, training/checkpoint.py); an explicit
+        # step_XXXXXXXX path is trusted as given (fail loud if damaged)
+        if cfg.resume:
+            path = None
+            if os.path.basename(cfg.resume).startswith("step_"):
+                self.state = restore_checkpoint(cfg.resume, self.state,
+                                                self.mesh)
+                path = cfg.resume
+            else:
+                try:
+                    self.state, path = restore_latest_good(
+                        cfg.resume, self.state, self.mesh,
+                        on_skip=self._log_restore_skip)
+                except FileNotFoundError:
+                    # nothing committed yet (fresh run dir) — start cold,
+                    # same as the pre-resilience behavior
+                    path = None
+            if path:
+                self.logger.info("resumed from %s (step %d)", path,
+                                 int(self.state.step))
+
+        self.logger.info(
+            "model=%s dataset=%s params=%.2fM workers=%d global_bs=%d "
+            "compressor=%s density=%g buckets=%d k_total=%d "
+            "steps/epoch=%d total_steps=%d",
+            cfg.dnn, cfg.dataset, n_params / 1e6, self.nworkers,
+            local_bs, comp.name, cfg.density, len(plan.buckets),
+            plan.total_k, self.steps_per_epoch, self.total_steps)
+        self.jsonl.write({"event": "config", **{
+            k: getattr(cfg, k) for k in ("dnn", "dataset", "batch_size",
+                                         "compressor", "density", "lr")},
+            "nworkers": self.nworkers, "n_params": n_params,
+            "total_steps": self.total_steps})
+
+    # ------------------------------------------------------------------
+    def _build_steps(self) -> None:
+        """(Re)build schedule + inner optimizer + the jitted step programs
+        at the current ``_lr_scale``. Called at construction and again
+        after a rollback (the backoff-scaled LR is baked into the traced
+        programs, so they must recompile — rollback-rare, and the
+        persistent compile cache usually softens it)."""
+        cfg = self.cfg
+        base = warmup_milestone_schedule(
             cfg.lr, self.nworkers, self.steps_per_epoch, self.total_steps,
             cfg.warmup_epochs, cfg.lr_milestones, cfg.lr_decay)
+        scale = self._lr_scale
+        self.schedule = (base if scale == 1.0
+                         else (lambda s: base(s) * scale))
+        # torch-SGD-equivalent chain (SURVEY.md §3.1)
         chain = []
         if cfg.weight_decay:
             # wd applied to the *exchanged* gradient, before momentum — the
@@ -173,29 +287,10 @@ class Trainer:
             flat_opt = FlatSGDM(lr=self.schedule,
                                 momentum=cfg.momentum or 0.0,
                                 weight_decay=cfg.weight_decay or 0.0)
-
-        # ---- compression + the fused step ----
-        # LSTM bptt carry across windows (the reference's "repackaging",
-        # SURVEY.md §3.2): hidden state lives in TrainState.carry,
-        # batch-dim sharded; reset at epoch boundaries (train loop).
-        self.recurrent = (cfg.dnn.lower() == "lstm" and cfg.carry_hidden)
-        comp = get_compressor(cfg.compressor, density=cfg.density,
-                              sigma_scale=cfg.sigma_scale)
-        plan = plan_for_params(params, cfg.density, cfg.bucket_size,
-                               policy=cfg.bucket_policy)
-        self.plan = plan
-        # uint8 pixel batches (imagenet contract) normalize ON DEVICE —
-        # the dtype check inside _prep_pixels is trace-time static, so
-        # float batches pay nothing
-        from .losses import IMAGENET_NORM
-        input_norm = (IMAGENET_NORM if cfg.dataset.lower() == "imagenet"
-                      else None)
         self.ts = build_dp_train_step(
-            make_loss_fn(self.spec, cfg.label_smoothing,
-                         recurrent=self.recurrent,
-                         input_norm=input_norm),
-            None if flat_opt is not None else optimizer, comp,
-            plan, self.mesh,
+            self._loss_fn,
+            None if flat_opt is not None else optimizer, self._comp,
+            self.plan, self.mesh,
             num_microbatches=cfg.nsteps_update,
             clip_norm=cfg.clip_norm,
             fold_lr=self.schedule if cfg.fold_lr else None,
@@ -203,56 +298,117 @@ class Trainer:
             exchange=cfg.exchange,
             sp_axis="sp" if self.sp else None,
             flat_opt=flat_opt,
+            guard_nonfinite=cfg.nonfinite_guard,
         )
-        carry = (self.spec.module.initial_carry(local_bs)
-                 if self.recurrent else ())
-        self.state = self.ts.init_state(params, state_rng,
-                                        model_state=model_state, carry=carry)
-        self.is_dense_only = comp.name == "none"
+        # drop caches keyed on the replaced programs (phase-timing probes,
+        # first-dispatch bookkeeping)
+        self._dispatched_fns = set()
+        self.__dict__.pop("_probes", None)
+        self.__dict__.pop("_probe_shapes", None)
 
-        # ---- eval step: shard_map'd sum-reduce over dp ----
-        eval_fn = make_eval_fn(self.spec, recurrent=self.recurrent,
-                               input_norm=input_norm)
-        axes = tuple(self.mesh.axis_names)
-        self._eval_bs = eval_bs
+    # ------------------------------------------------------------------
+    @property
+    def state(self):
+        return self._state
 
-        def eval_step(params, mstate, batch, *carry):
-            if self.recurrent:
-                sums, new_carry = eval_fn(params, mstate, batch, carry[0])
-            else:
-                sums, new_carry = eval_fn(params, mstate, batch), None
-            sums = jax.tree.map(lambda x: jax.lax.psum(x, axes), sums)
-            return (sums, new_carry) if self.recurrent else sums
+    @state.setter
+    def state(self, new_state) -> None:
+        """Overwriting the state from OUTSIDE the train loop (resume,
+        rollback, elastic handoff, tests assigning a restored state) moves
+        ``state.step``, so the cached data iterator — which aligned its
+        epoch/skip position to the OLD step when first built — would
+        silently replay the wrong epoch position, and the cached Python
+        step counter would desynchronize. Route every external assignment
+        through this setter so both caches die with the stale step. The
+        train loop itself advances ``self._state`` directly (its step
+        increments match the stream position, and tearing down the
+        prefetch thread every step would defeat it)."""
+        self._state = new_state
+        self._invalidate_data_iter()
+        self.__dict__.pop("_step_cache", None)
+        # external assignment starts a NEW trajectory: steps re-reached
+        # after a resume-from-older/rollback may collide with sealed
+        # checkpoints of the old one, which must be overwritten, not
+        # idempotently skipped (_save_checkpoint)
+        self._saved_steps: set = set()
 
-        batch_in = self._batch_spec if self.sp else P(axes)
-        in_specs = (P(), P(), batch_in) + ((P(axes),) if self.recurrent
-                                           else ())
-        out_specs = (P(), P(axes)) if self.recurrent else P()
-        self.eval_step = jax.jit(shard_map(
-            eval_step, mesh=self.mesh,
-            in_specs=in_specs, out_specs=out_specs, check_vma=False))
+    def _invalidate_data_iter(self) -> None:
+        # the orphaned prefetch daemon thread (if any) parks on its full
+        # queue and dies with the process — bounded by max_rollbacks, not
+        # worth a teardown protocol
+        self._iter = None
 
-        # ---- resume ----
-        if cfg.resume:
-            path = (cfg.resume if os.path.basename(cfg.resume).startswith(
-                "step_") else latest_checkpoint(cfg.resume))
-            if path:
-                self.state = restore_checkpoint(path, self.state, self.mesh)
-                self.logger.info("resumed from %s (step %d)", path,
-                                 int(self.state.step))
+    # ------------------------------------------------------------------
+    def _save_checkpoint(self) -> str:
+        """Seal a checkpoint for the current step. A step already saved by
+        THIS trajectory (e.g. epoch-boundary + final save landing on the
+        same step) is an idempotent no-op; a step first reached on this
+        trajectory OVERWRITES any sealed dir a previous trajectory left
+        there (resume-from-an-older-checkpoint, post-rollback replay with
+        a backed-off LR) — silently keeping the stale state would poison a
+        later resume/rollback."""
+        step = self.step
+        path = save_checkpoint(self.ckpt_dir, self._state,
+                               overwrite=step not in self._saved_steps)
+        self._saved_steps.add(step)
+        if self.cfg.keep_checkpoints:
+            removed = gc_checkpoints(self.ckpt_dir,
+                                     self.cfg.keep_checkpoints)
+            for r in removed:
+                self.logger.info("checkpoint GC: removed %s", r)
+        return path
 
-        self.logger.info(
-            "model=%s dataset=%s params=%.2fM workers=%d global_bs=%d "
-            "compressor=%s density=%g buckets=%d k_total=%d "
-            "steps/epoch=%d total_steps=%d",
-            cfg.dnn, cfg.dataset, n_params / 1e6, self.nworkers,
-            local_bs, comp.name, cfg.density, len(plan.buckets),
-            plan.total_k, self.steps_per_epoch, self.total_steps)
-        self.jsonl.write({"event": "config", **{
-            k: getattr(cfg, k) for k in ("dnn", "dataset", "batch_size",
-                                         "compressor", "density", "lr")},
-            "nworkers": self.nworkers, "n_params": n_params,
-            "total_steps": self.total_steps})
+    def _log_restore_skip(self, path: str, exc: Exception) -> None:
+        self.logger.warning("restore fallback: skipping %s (%s: %s)",
+                            path, type(exc).__name__, exc)
+        self.jsonl.write({"event": "restore_fallback", "checkpoint": path,
+                          "error": f"{type(exc).__name__}: {exc}"})
+
+    def _rollback(self, reason: str) -> None:
+        """Automatic divergence recovery (docs/RESILIENCE.md): restore the
+        newest restorable checkpoint OLDER than the observed anomaly (a
+        checkpoint sealed at/after it already holds the diverged state),
+        back off the LR, rebuild the step programs, and realign the data
+        stream — the error-feedback residual, optimizer state, and step
+        counter all rewind together because they are one checkpointed
+        TrainState."""
+        anomaly_step = self.monitor.pending_since
+        n = self.monitor.note_rollback()   # raises when budget exhausted
+        self._lr_scale = self.monitor.lr_scale
+        try:
+            try:
+                state, path = restore_latest_good(
+                    self.ckpt_dir, self._state, self.mesh,
+                    on_skip=self._log_restore_skip,
+                    before_step=anomaly_step)
+            except FileNotFoundError:
+                if anomaly_step is None:
+                    raise
+                # every sealed checkpoint is at/after the anomaly — the
+                # pre-divergence trajectory was never saved. Restore the
+                # newest anyway: only the LR backoff helps then, but it
+                # beats killing the run while rollback budget remains.
+                self.logger.warning(
+                    "rollback: no checkpoint precedes anomalous step %d; "
+                    "restoring the newest sealed one instead",
+                    anomaly_step)
+                state, path = restore_latest_good(
+                    self.ckpt_dir, self._state, self.mesh,
+                    on_skip=self._log_restore_skip)
+        except (FileNotFoundError, RuntimeError) as e:
+            raise RuntimeError(
+                f"rollback ({reason}) has no restorable checkpoint under "
+                f"{self.ckpt_dir!r} — enable save_every_steps so a "
+                f"rollback target exists (docs/RESILIENCE.md)") from e
+        to_step = int(jax.device_get(state.step))
+        self.jsonl.write({"event": "rollback", "reason": reason,
+                          "rollback": n, "to_step": to_step,
+                          "lr_scale": self._lr_scale, "checkpoint": path})
+        self.logger.warning(
+            "rollback #%d (%s): restored %s (step %d), lr_scale=%g",
+            n, reason, path, to_step, self._lr_scale)
+        self._build_steps()
+        self.state = state      # setter: drops data iter + step cache
 
     # ------------------------------------------------------------------
     def _dummy_inputs(self):
@@ -277,9 +433,11 @@ class Trainer:
         """Run ``num_iters`` optimizer steps (reference ``trainer.train(n)``,
         SURVEY.md §1.1 L4->L3 interface). Returns mean metrics."""
         cfg = self.cfg
-        it = data_iter if data_iter is not None else self._train_iter()
         losses, last = [], {}
         for _ in range(num_iters):
+            # resolved per iteration: a rollback mid-run invalidates the
+            # cached iterator, and the rebuilt one must be picked up here
+            it = data_iter if data_iter is not None else self._train_iter()
             # jax.profiler trace window (SURVEY.md §5 Tracing rebuild note:
             # real fwd/bwd/comm breakdown comes from device traces, not
             # host timers). cfg.profile_steps = (start, stop).
@@ -305,8 +463,10 @@ class Trainer:
             if (self.recurrent and step % self.steps_per_epoch == 0
                     and step > 0):
                 # fresh text stream at each epoch wrap -> fresh carry
-                self.state = self.state._replace(carry=jax.tree.map(
-                    jnp.zeros_like, self.state.carry))
+                # (direct _state write: the loop's own advances must not
+                # trip the external-assignment invalidation in the setter)
+                self._state = self._state._replace(carry=jax.tree.map(
+                    jnp.zeros_like, self._state.carry))
             fn = (self.ts.dense_step if self._in_warmup(step)
                   else self.ts.sparse_step)
             if cfg.phase_timing:
@@ -321,41 +481,89 @@ class Trainer:
                 if key not in self._dispatched_fns:
                     self._dispatched_fns.add(key)
                     self._interval_has_compile = True
-            self.state, m = fn(self.state, batch)
+            self._state, m = fn(self._state, batch)
             # jit dispatch is async: sync before stopping the timer so
             # step_s/ex-s measure device work, not dispatch latency
             jax.block_until_ready(m.loss)
             self._step_cache = step + 1
             self.timers.stop()
             losses.append(m)
-            if (step + 1) % cfg.log_every == 0:
-                last = self._log_train(step + 1, m)
+            done = step + 1
+            if self.monitor is not None:
+                # m.loss is already synced above, so these per-step host
+                # reads cost a device_get of two ready scalars, not a sync
+                sk = float(jax.device_get(m.skipped))
+                if sk:
+                    nf = float(jax.device_get(m.nonfinite))
+                    self.jsonl.write({"event": "skip", "step": done,
+                                      "nonfinite": nf})
+                    self.logger.warning(
+                        "step %d skipped by in-step guard (%g non-finite "
+                        "grad entries); state unchanged", done, nf)
+                self.monitor.observe(done, float(jax.device_get(m.loss)),
+                                     sk)
+            pending = (self.monitor.should_rollback()
+                       if self.monitor is not None else None)
+            if cfg.save_every_steps and done % cfg.save_every_steps == 0:
+                if pending is None:
+                    path = self._save_checkpoint()
+                    self.logger.info("checkpoint -> %s", path)
+                else:
+                    # sealing the live state while a rollback is pending
+                    # would make the suspect/diverged state the newest —
+                    # and therefore the rollback target — checkpoint
+                    self.logger.warning(
+                        "cadence save at step %d suppressed: rollback "
+                        "pending (%s)", done, pending)
+            if self.shutdown.requested:
+                # preemption contract (docs/RESILIENCE.md): seal a
+                # checkpoint at the step boundary, then exit cleanly
+                path = self._save_checkpoint()
+                self.jsonl.write({"event": "preempt", "step": done,
+                                  "checkpoint": path})
+                self.logger.warning(
+                    "shutdown requested: checkpointed %s at step %d",
+                    path, done)
+                raise TrainingPreempted(done, path)
+            if done % cfg.log_every == 0:
+                last = self._log_train(done, m)
+                if self.monitor is not None:
+                    # policy ACTS only at log intervals (ISSUE contract);
+                    # between intervals it only accumulates observations
+                    reason = self.monitor.should_rollback()
+                    if reason:
+                        self._rollback(reason)
         if losses and not last:
             last = self._log_train(self.step, losses[-1], quiet=True)
         return last
 
     def _train_iter(self):
-        if not hasattr(self, "_iter"):
-            self._iter = iter(data_lib.prefetch(self._stream(), depth=2))
+        if getattr(self, "_iter", None) is None:
+            self._iter = iter(data_lib.prefetch(
+                self._stream(), depth=2,
+                max_retries=self.cfg.io_retries,
+                backoff_s=self.cfg.io_backoff_s,
+                on_event=self._io_event))
         return self._iter
+
+    def _io_event(self, rec: Dict[str, Any]) -> None:
+        # runs on the prefetch thread; JSONLWriter is lock-serialized
+        self.jsonl.write(rec)
+        self.logger.warning(
+            "data io retry %s/%s after %s (backoff %.3gs)",
+            rec.get("attempt"), rec.get("max_retries"), rec.get("error"),
+            rec.get("backoff_s", 0.0))
 
     def _stream(self):
         """Epoch stream aligned to the current step — a resumed run
         continues with the SAME epoch shuffle order and position an
         uninterrupted run would see (exact data-iterator resume,
-        SURVEY.md §5 checkpoint rebuild note)."""
-        ep = self.step // self.steps_per_epoch
-        skip = self.step % self.steps_per_epoch
-        while True:
-            # every pipeline class (ArrayDataset, CifarPipeline, PTBDataset)
-            # accepts epoch_seed, so resume realignment is uniform
-            it = self.train_ds.epoch(epoch_seed=self.cfg.seed + ep)
-            for i, b in enumerate(it):
-                if skip and i < skip:
-                    continue
-                yield b
-            skip = 0
-            ep += 1
+        SURVEY.md §5 checkpoint rebuild note). Class-based/resumable
+        (data_lib.EpochStream), NOT a generator: prefetch's transient-IO
+        retry must be able to re-pull after a raise — a generator dies on
+        its first raise and would turn io_retries into a silent
+        end-of-stream."""
+        return data_lib.EpochStream(self.train_ds, self.cfg.seed, self.step)
 
     def _phase_breakdown(self, step_s: float) -> Dict[str, object]:
         # values are float seconds, except the string-valued
@@ -418,7 +626,12 @@ class Trainer:
             "bytes_sent": int(jax.device_get(m.bytes_sent)),
             "density": self.cfg.density,
             "io_s": means.get("io", 0.0), "step_s": means.get("step", 0.0),
+            "skipped": float(jax.device_get(m.skipped)),
+            "nonfinite": float(jax.device_get(m.nonfinite)),
         }
+        if self.monitor is not None:
+            rec["consecutive_skips"] = self.monitor.consecutive_skips
+            rec["lr_scale"] = self._lr_scale
         if self.cfg.phase_timing and not quiet:
             rec.update(self._phase_breakdown(rec["step_s"]))
         aux = jax.device_get(m.aux)
@@ -486,20 +699,44 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def fit(self) -> Dict[str, float]:
-        """The reference's outer epoch loop (SURVEY.md §3.1)."""
+        """The reference's outer epoch loop (SURVEY.md §3.1), wrapped in
+        the resilience runtime: SIGTERM/SIGINT checkpoint-then-exit, and
+        step-budgeted saves/rollbacks inside :meth:`train`."""
         cfg = self.cfg
         result: Dict[str, float] = {}
-        ckpt_dir = os.path.join(self.run_dir, "ckpt")
-        while self.step < self.total_steps:
-            n = min(self.steps_per_epoch, self.total_steps - self.step)
-            self.train(n)
-            ep = self.epoch
-            if cfg.eval_every_epochs and ep % cfg.eval_every_epochs == 0:
-                result = self.test(ep)
-            if cfg.save_every_epochs and ep % cfg.save_every_epochs == 0:
-                path = save_checkpoint(ckpt_dir, self.state)
-                self.logger.info("checkpoint -> %s", path)
-        save_checkpoint(ckpt_dir, self.state)
+        # signal.signal is a main-thread-only API (CPython); fits driven
+        # from worker threads (tests, notebooks) skip the handlers but
+        # keep the programmatic shutdown.request() path
+        install = (cfg.handle_signals
+                   and threading.current_thread() is threading.main_thread())
+        if install:
+            self.shutdown.install()
+        try:
+            while self.step < self.total_steps:
+                n = min(self.steps_per_epoch, self.total_steps - self.step)
+                self.train(n)
+                ep = self.epoch
+                if cfg.eval_every_epochs and ep % cfg.eval_every_epochs == 0:
+                    result = self.test(ep)
+                if (cfg.save_every_epochs
+                        and ep % cfg.save_every_epochs == 0
+                        and (self.monitor is None
+                             or self.monitor.should_rollback() is None)):
+                    # same suppression as the step-cadence save: a pending
+                    # rollback (detected after the last log interval of the
+                    # epoch) must not seal the suspect state
+                    path = self._save_checkpoint()
+                    self.logger.info("checkpoint -> %s", path)
+            self._save_checkpoint()
+        except TrainingPreempted as e:
+            # clean exit: the checkpoint is sealed, the caller decides
+            # whether to reschedule (train.py just returns)
+            self.logger.warning("training preempted at step %d "
+                                "(checkpoint: %s)", e.step, e.ckpt_path)
+            result = {**result, "preempted_at": float(e.step)}
+        finally:
+            if install:
+                self.shutdown.uninstall()
         return result
 
     def close(self):
