@@ -22,12 +22,17 @@ HLO FLOPs / (step time x chip bf16 peak) — the absolute-performance leg
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
 import statistics
+import sys
+from typing import List, Optional
 
 import jax
 
 from gaussiank_sgd_tpu.compressors import DEFAULT_SELECTOR
+from gaussiank_sgd_tpu.telemetry import EventBus, JSONLExporter
 
 FIXED = DEFAULT_SELECTOR        # the codified ex-ante policy (registry.py)
 SWEEP = (FIXED, "gaussian_warm", "approxtopk16")
@@ -48,6 +53,13 @@ CONFIGS = (
     ("transformer_wmt", "transformer", "wmt", 32, 10, 7),
 )
 
+# --smoke: one tiny config, CI-sized (seconds, not minutes, on CPU) — the
+# point is exercising the full harness + telemetry emission path, not a
+# meaningful throughput number
+SMOKE_CONFIGS = (
+    ("mnistnet", "mnistnet", "mnist", 8, 2, 2),
+)
+
 
 def _ratios(times, name):
     """median/min sparse:dense ratios from per-round samples, paired by
@@ -63,18 +75,39 @@ def _ratios(times, name):
     }
 
 
-def main():
+def main(argv: Optional[List[str]] = None):
     from gaussiank_sgd_tpu import virtual_cpu
     from gaussiank_sgd_tpu.benchlib import bench_model, mfu
+
+    # default [] (not sys.argv): the test harness calls main() inside a
+    # pytest process whose argv is pytest's, not ours
+    ap = argparse.ArgumentParser(prog="bench.py")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny single-config run for CI: exercises the "
+                         "harness + telemetry emission, not a real number")
+    args = ap.parse_args([] if argv is None else argv)
 
     # persistent compile cache: repeated driver runs skip the multi-minute
     # 20-60M-param compiles (drift windows change, programs don't)
     virtual_cpu.enable_compile_cache("/tmp/gksgd_tpu_cache")
 
+    artifacts = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "analysis", "artifacts")
+    os.makedirs(artifacts, exist_ok=True)
+    # machine-readable record stream (docs/OBSERVABILITY.md): one
+    # schema-validated bench_model event per config + a bench_summary,
+    # through the same exporter interface the trainer uses. mode='w': each
+    # run is a fresh single-run stream; validate=True: a schema drift
+    # fails HERE (and in the CI smoke), not in a downstream parser.
+    bus = EventBus([JSONLExporter(
+        os.path.join(artifacts, "bench_events.jsonl"), mode="w")],
+        validate=True)
+
     density = 0.001
     detail_configs = {}
     headline = None
-    for key, model, dataset, batch, n_steps, rounds in CONFIGS:
+    configs = SMOKE_CONFIGS if args.smoke else CONFIGS
+    for key, model, dataset, batch, n_steps, rounds in configs:
         # the flagship config also runs the 3-selector sweep (secondary
         # winner field); the other configs run the fixed selector only to
         # bound driver wall-clock
@@ -104,6 +137,16 @@ def main():
             }
             headline = cell
         detail_configs[key] = cell
+        bus.emit("bench_model", key=key, model=model, dataset=dataset,
+                 batch=batch, compressor=FIXED,
+                 dense_step_ms=cell["dense_step_ms"],
+                 sparse_step_ms=cell["sparse_step_ms"],
+                 ratio_median=cell["ratio_median"],
+                 ratio_min=cell["ratio_min"],
+                 ratio_max=cell["ratio_max"],
+                 ex_per_s_chip=cell["ex_per_s_chip"],
+                 mfu_dense=cell["mfu_dense"],
+                 mfu_sparse=cell["mfu_sparse"])
         print(f"# {key}: median {cell['ratio_median']} "
               f"min {cell['ratio_min']} mfu_dense {cell['mfu_dense']}",
               flush=True)
@@ -114,6 +157,10 @@ def main():
     worst_key, worst = min(detail_configs.items(),
                            key=lambda kv: kv[1]["ratio_median"])
     value = worst["ratio_median"]
+    bus.emit("bench_summary",
+             metric="sparse_vs_dense_step_throughput_ratio", value=value,
+             worst_config=worst_key, smoke=args.smoke)
+    bus.close()
     result = {
         "metric": "sparse_vs_dense_step_throughput_ratio",
         "value": value,
@@ -126,7 +173,8 @@ def main():
                         f"policy), density {density}",
             "worst_config": worst_key,
             "worst_config_ratio_median": worst["ratio_median"],
-            "flagship_ratio_median": headline["ratio_median"],
+            "flagship_ratio_median": (headline["ratio_median"]
+                                      if headline else None),
             "configs": detail_configs,
             "methodology": "N-step fori_loop per dispatch, scalar fence, "
                            "interleaved rotated rounds; ratios paired "
@@ -138,10 +186,6 @@ def main():
     # full per-round detail -> artifact (the driver's record keeps only a
     # tail of stdout, which truncated the r3 multi-KB line mid-JSON); the
     # FINAL stdout line stays compact enough to survive any tail window
-    import os
-    artifacts = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             "analysis", "artifacts")
-    os.makedirs(artifacts, exist_ok=True)
     with open(os.path.join(artifacts, "bench_last.json"), "w") as f:
         json.dump(result, f, indent=2)
     compact = {
@@ -163,4 +207,4 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    main(sys.argv[1:])

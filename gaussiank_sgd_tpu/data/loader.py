@@ -183,9 +183,9 @@ def prefetch(it: Iterator, depth: int = 2, max_retries: int = 0,
     recognized (the pull DID fail) and the original transient error is
     re-raised instead of silently ending the stream. Each attempt emits an
     ``{"event": "io_retry", ...}`` record through ``on_event`` (the
-    Trainer wires this to its JSONL metrics stream); ``on_event`` runs on
-    the prefetch thread, so the sink must be thread-safe
-    (metrics.JSONLWriter is).
+    Trainer wires this to its telemetry EventBus, which stamps the
+    schema/seq envelope); ``on_event`` runs on the prefetch thread, so
+    the sink must be thread-safe (telemetry.EventBus.publish is).
     """
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     _END = object()

@@ -11,7 +11,7 @@ import os
 from typing import List, Optional, Sequence, Set
 
 from . import (control_flow, donation, fail_loud, host_sync, mesh_axes,
-               recompile)
+               print_in_library, recompile)
 
 ALL_RULES = [
     host_sync.Rule(),
@@ -20,6 +20,7 @@ ALL_RULES = [
     donation.Rule(),
     control_flow.Rule(),
     fail_loud.Rule(),
+    print_in_library.Rule(),
 ]
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -30,13 +30,23 @@ published algorithm's behavior, kept for parity; the allgather exchange
 from __future__ import annotations
 
 import math
-from typing import Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..compressors.base import CompressedGrad
+
+
+class GtopkCommStats(NamedTuple):
+    """Trace-time comm accounting for one butterfly exchange (telemetry:
+    the bytes_sent / per-round breakdown on the gtopk path is measured
+    from the concrete ppermuted buffers, never a closed-form estimate)."""
+
+    bytes_sent: int          # summed payload bytes handed to ppermute
+    rounds: int              # log2(P) butterfly rounds executed
+    entries_per_round: int   # packed (idx, val) pairs exchanged per round
 
 
 def merge_sparse(idx_a: jax.Array, val_a: jax.Array, idx_b: jax.Array,
@@ -66,25 +76,27 @@ def merge_sparse(idx_a: jax.Array, val_a: jax.Array, idx_b: jax.Array,
 
 
 def gtopk_allreduce(comp: CompressedGrad, num_devices: int,
-                    axis_name: str) -> Tuple[CompressedGrad, int]:
+                    axis_name: str) -> Tuple[CompressedGrad, GtopkCommStats]:
     """Butterfly gTop-k: log2(P) ppermute rounds; result identical on every
     worker (the global top-k of the summed sparse gradients, k entries).
 
-    Returns ``(global_topk, bytes_sent)``. ``bytes_sent`` is a trace-time
-    Python int: the summed byte size of the buffers actually handed to
-    ``ppermute`` — a count of the concrete exchanged arrays (shape x
-    itemsize per round), not a closed-form estimate, so metric and program
-    cannot drift apart (VERDICT r2 item 7 "measured, not formula"). It is
-    part of the return value, not a function attribute, so code motion or a
-    second call between trace and read cannot report a stale count
-    (ADVICE r3).
+    Returns ``(global_topk, comm_stats)``. ``comm_stats.bytes_sent`` is a
+    trace-time Python int: the summed byte size of the buffers actually
+    handed to ``ppermute`` — a count of the concrete exchanged arrays
+    (shape x itemsize per round), not a closed-form estimate, so metric and
+    program cannot drift apart (VERDICT r2 item 7 "measured, not formula").
+    It is part of the return value, not a function attribute, so code
+    motion or a second call between trace and read cannot report a stale
+    count (ADVICE r3). ``rounds``/``entries_per_round`` feed the telemetry
+    stream's comms accounting (docs/OBSERVABILITY.md).
     """
     p = num_devices
     assert p & (p - 1) == 0, f"gtopk needs power-of-2 workers, got {p}"
     k = comp.indices.shape[0]
     idx, val = comp.indices, comp.values
     bytes_sent = 0
-    for r in range(int(math.log2(p))):
+    n_rounds = int(math.log2(p))
+    for r in range(n_rounds):
         stride = 1 << r
         perm = [(j, j ^ stride) for j in range(p)]
         bytes_sent += (idx.size * idx.dtype.itemsize
@@ -92,7 +104,9 @@ def gtopk_allreduce(comp: CompressedGrad, num_devices: int,
         o_idx = lax.ppermute(idx, axis_name, perm)
         o_val = lax.ppermute(val, axis_name, perm)
         idx, val = merge_sparse(idx, val, o_idx, o_val, k)
-    return CompressedGrad(idx, val), bytes_sent
+    stats = GtopkCommStats(bytes_sent=bytes_sent, rounds=n_rounds,
+                           entries_per_round=k)
+    return CompressedGrad(idx, val), stats
 
 
 def global_residual(acc: jax.Array, global_comp: CompressedGrad) -> jax.Array:

@@ -111,6 +111,19 @@ class StepMetrics(NamedTuple):
                               # entries this step (+1 if the loss itself is
                               # non-finite); 0 on clean steps and when the
                               # guard is disabled
+    # --- on-device telemetry accounting (docs/OBSERVABILITY.md): computed
+    # inside the jitted step (psum'd alongside the existing metrics, zero
+    # host sync) and drained with the rest of the metrics at log time ---
+    achieved_density: jax.Array  # float32: dp-mean selected entries /
+                              # total params (pre-truncation, like
+                              # num_selected); 1.0 on the dense path
+    ef_norm: jax.Array        # float32: global L2 norm of the COMMITTED
+                              # error-feedback residual (all workers'
+                              # shards; reflects the post-guard state, so
+                              # a skipped step reports the old residual)
+    sel_per_bucket: jax.Array  # float32[n_buckets]: dp-mean per-bucket
+                              # selection counts — the per-bucket comms
+                              # breakdown (dense path: bucket sizes)
 
 
 # loss_fn(params, model_state, batch, rng)
@@ -218,7 +231,10 @@ def compress_buckets(spec: CompressorSpec, plan: BucketPlan, acc: jax.Array,
     Bucket-local indices are offset into the global flat space so the whole
     model exchanges as ONE (idx, val) pair of arrays — one collective per
     step no matter how many buckets (SURVEY.md §7 design stance). Returns
-    (CompressedGrad over global flat indices, residual, num_selected).
+    (CompressedGrad over global flat indices, residual, num_selected,
+    comp_state); ``num_selected`` is the PER-BUCKET int32 vector
+    ``[n_buckets]`` of entries crossing each bucket's threshold
+    (pre-truncation) — sum it for the scalar count.
 
     Uniform plans (every bucket same size+k, ``policy='uniform'``) take the
     vectorized path: one ``vmap`` of the compressor over a
@@ -260,10 +276,10 @@ def compress_buckets(spec: CompressorSpec, plan: BucketPlan, acc: jax.Array,
         comp = CompressedGrad((r.compressed.indices + offs).reshape(-1),
                               r.compressed.values.reshape(-1))
         residual = r.residual.reshape(-1)[:acc.shape[0]]
-        return (comp, residual, jnp.sum(r.num_selected),
+        return (comp, residual, r.num_selected.astype(jnp.int32).reshape(-1),
                 st_new if spec.stateful else comp_state)
 
-    idx_parts, val_parts, res_parts, nsel = [], [], [], jnp.int32(0)
+    idx_parts, val_parts, res_parts, nsel_parts = [], [], [], []
     st_parts = []
     for i, b in enumerate(plan.buckets):
         chunk = lax.dynamic_slice_in_dim(acc, b.offset, b.size)
@@ -273,10 +289,10 @@ def compress_buckets(spec: CompressorSpec, plan: BucketPlan, acc: jax.Array,
         val_parts.append(r.compressed.values)
         res_parts.append(r.residual)
         st_parts.append(st_new)
-        nsel = nsel + r.num_selected
+        nsel_parts.append(r.num_selected.astype(jnp.int32))
     comp = CompressedGrad(jnp.concatenate(idx_parts),
                           jnp.concatenate(val_parts))
-    return (comp, jnp.concatenate(res_parts), nsel,
+    return (comp, jnp.concatenate(res_parts), jnp.stack(nsel_parts),
             jnp.stack(st_parts) if spec.stateful else comp_state)
 
 
@@ -434,6 +450,19 @@ def build_dp_train_step(
         comp_rng = jax.random.fold_in(base, 1)
         return data_rng, comp_rng
 
+    # trace-time constant: per-bucket element counts, the dense path's
+    # "everything was sent" sel_per_bucket (telemetry accounting)
+    bucket_sizes_f32 = tuple(float(b.size) for b in plan.buckets)
+
+    def _ef_norm(residual: jax.Array) -> jax.Array:
+        """Global L2 norm of the EF residual: local shard sum-of-squares
+        psum'd over every mesh axis (each worker owns its own slice), then
+        sqrt — replicated like the other metrics, no host sync."""
+        ss = jnp.sum(jnp.square(residual.astype(jnp.float32)))
+        for a in axes:
+            ss = lax.psum(ss, a)
+        return jnp.sqrt(ss)
+
     def _guard_count(loss: jax.Array, flat_g: jax.Array) -> jax.Array:
         """Global non-finite count: per-worker grad-entry count psum'd over
         every mesh axis (all workers must agree — one worker's NaN pollutes
@@ -533,14 +562,14 @@ def build_dp_train_step(
             from .gtopk import global_residual, gtopk_allreduce
             # trace-time count of the buffers actually ppermuted (shape x
             # itemsize per butterfly round) — measured, not a formula
-            gcomp, n_bytes = gtopk_allreduce(comp, mesh.size, gather_axis)
+            gcomp, comm = gtopk_allreduce(comp, mesh.size, gather_axis)
             # the /P average rides the k-sized VALUES, not the n-sized
             # dense buffer: one full read+write pass saved (r4 floor work)
             gcomp = gcomp._replace(values=gcomp.values / _all_axes_size())
             if flat_opt is None:
                 dense = decompress(gcomp, n_total, grad_dtype)
             residual = global_residual(acc, gcomp)
-            bytes_sent = jnp.float32(n_bytes)
+            bytes_sent = jnp.float32(comm.bytes_sent)
         else:
             # ONE all-gather of the packed pairs over the (ICI) gather axis,
             # scatter-summed dense; hierarchical meshes psum the dense
@@ -582,10 +611,19 @@ def build_dp_train_step(
             nonfinite = cnt.astype(jnp.float32)
         else:
             skipped = nonfinite = jnp.float32(0)
+        # on-device comms/compression accounting (telemetry): one pmean of
+        # the per-bucket count vector serves num_selected, the achieved
+        # density, AND the per-bucket breakdown; the EF norm reads the
+        # COMMITTED residual so a guard-skipped step reports the state
+        # that actually persists
+        sel_per_bucket = _pmean(nsel.astype(jnp.float32))
+        num_selected = jnp.sum(sel_per_bucket)
         return new_state, StepMetrics(
             loss, aux, _pmean(jnp.linalg.norm(flat_g)),
-            _pmean(nsel.astype(jnp.float32)), bytes_sent, skipped,
-            nonfinite)
+            num_selected, bytes_sent, skipped, nonfinite,
+            achieved_density=num_selected / n_total,
+            ef_norm=_ef_norm(new_state.ef_residual),
+            sel_per_bucket=sel_per_bucket)
 
     def dense_step_fn(state: TrainState, batch: Any):
         data_rng, _ = _step_rngs(state)
@@ -617,7 +655,10 @@ def build_dp_train_step(
         return new_state, StepMetrics(
             loss, aux, _pmean(jnp.linalg.norm(flat_g)),
             jnp.float32(n_total), jnp.float32(n_total * 4), skipped,
-            nonfinite)
+            nonfinite,
+            achieved_density=jnp.float32(1.0),
+            ef_norm=_ef_norm(new_state.ef_residual),
+            sel_per_bucket=jnp.asarray(bucket_sizes_f32, jnp.float32))
 
     if sp_axis is None:
         batch_spec = P(axes)        # leading dim sharded over every dp axis
@@ -669,7 +710,8 @@ def build_dp_train_step(
             comp, residual, nsel, _cstate = compress_buckets(
                 spec, plan, acc, comp_rng,
                 state.comp_state[0] if spec.stateful else ())
-            sink = (nsel.astype(jnp.float32) + jnp.sum(comp.values)
+            sink = (jnp.sum(nsel).astype(jnp.float32)
+                    + jnp.sum(comp.values)
                     + jnp.sum(residual[:1]) + jnp.sum(residual[-1:]))
             return _pmean(sink) + 0.0 * loss
 

@@ -1,0 +1,92 @@
+"""Telemetry CLI.
+
+    python -m gaussiank_sgd_tpu.telemetry report run.jsonl        # summary
+    python -m gaussiank_sgd_tpu.telemetry report run.jsonl --json
+    python -m gaussiank_sgd_tpu.telemetry validate run.jsonl      # schema
+    python -m gaussiank_sgd_tpu.telemetry validate run.jsonl --strict
+
+``report`` reconstructs per-phase timing, comms-volume, compression and
+resilience summaries from the JSONL stream alone; ``validate`` schema-
+checks every record and the seq envelope (truncation, gaps, mixed-run
+resets). Exit codes: 0 ok, 1 validation problems, 2 usage error.
+
+Pure stdlib — runs without initializing jax (like the lint CLI).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .events import validate_file
+from .report import format_report, load_events, summarize
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gaussiank_sgd_tpu.telemetry",
+        description="inspect/validate a telemetry JSONL event stream")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    rp = sub.add_parser("report", help="summarize a run's event stream")
+    rp.add_argument("path", help="metrics.jsonl / events file")
+    rp.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable summary")
+
+    vp = sub.add_parser("validate", help="schema-check an event stream")
+    vp.add_argument("path")
+    vp.add_argument("--strict", action="store_true",
+                    help="require the full envelope and known event kinds "
+                         "on every record (freshly written streams)")
+    vp.add_argument("--json", action="store_true", dest="as_json")
+
+    args = ap.parse_args(argv)
+
+    try:
+        if args.cmd == "report":
+            events = load_events(args.path)
+            if not events:
+                print(f"error: no telemetry records in {args.path}",
+                      file=sys.stderr)
+                return 1
+            summary = summarize(events)
+            print(json.dumps(summary, indent=2, default=float)
+                  if args.as_json else format_report(summary))
+            return 0
+
+        rep = validate_file(args.path, strict=args.strict)
+        if args.as_json:
+            print(json.dumps({
+                "path": args.path,
+                "ok": rep.ok,
+                "n_records": rep.n_records,
+                "n_stamped": rep.n_stamped,
+                "events": rep.events,
+                "seq_gaps": rep.seq_gaps,
+                "seq_resets": rep.seq_resets,
+                "truncated": rep.truncated,
+                "errors": rep.errors,
+                "warnings": rep.warnings,
+            }, indent=2))
+        else:
+            for msg in rep.errors:
+                print(f"ERROR {msg}")
+            for msg in rep.warnings:
+                print(f"warn  {msg}")
+            status = "OK" if rep.ok else "FAIL"
+            print(f"{status}: {rep.n_records} record(s), "
+                  f"{rep.n_stamped} seq-stamped, "
+                  f"{len(rep.errors)} error(s), "
+                  f"{len(rep.warnings)} warning(s) — "
+                  + ", ".join(f"{k}={n}"
+                              for k, n in sorted(rep.events.items())))
+        return 0 if rep.ok else 1
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+"""The event bus — one stamped, ordered stream for every runtime event.
+
+Replaces the fragmented pre-telemetry wiring (a bare JSONLWriter in the
+trainer, ad-hoc dicts from the data loader's prefetch thread, resilience
+events written inline): every producer publishes a plain dict with an
+``event`` discriminator; the bus stamps the envelope (schema_version,
+monotonic seq, host timestamp) under one lock and fans the record out to
+every attached exporter IN ORDER — so the per-exporter streams carry the
+same total order the seq numbers promise, even with the prefetch thread
+publishing io_retry events concurrently with the train loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Mapping, Optional
+
+from .events import SCHEMA_VERSION, validate_record
+from .exporters import Exporter
+
+
+class EventBus:
+    """Thread-safe publish/fan-out hub for telemetry records.
+
+    ``validate=True`` schema-checks every record at publish time and
+    raises on a violation — the fail-loud mode tests and the bench smoke
+    run under; production trainers keep it off (a telemetry bug must not
+    kill a training run that is otherwise healthy... but a SCHEMA bug
+    should be caught in CI, where validate is on).
+
+    ``clock`` is injectable for deterministic tests.
+    """
+
+    def __init__(self, exporters: Iterable[Exporter] = (),
+                 validate: bool = False,
+                 clock: Callable[[], float] = time.time):
+        self._exporters = list(exporters)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._validate = validate
+        self._clock = clock
+        self._closed = False
+
+    def attach(self, exporter: Exporter) -> Exporter:
+        with self._lock:
+            self._exporters.append(exporter)
+        return exporter
+
+    @property
+    def seq(self) -> int:
+        """Next sequence number to be assigned (== records published)."""
+        with self._lock:
+            return self._seq
+
+    def emit(self, event: str, /, **fields: Any) -> Dict[str, Any]:
+        """Publish ``{"event": event, **fields}``; returns the stamped
+        record."""
+        return self.publish({"event": event, **fields})
+
+    def publish(self, record: Mapping[str, Any]) -> Dict[str, Any]:
+        """Stamp the envelope onto a copy of ``record`` and hand it to
+        every exporter. The caller's dict is never mutated. Also usable
+        directly as a ``Callable[[dict], None]`` sink (data/loader.py's
+        ``on_event``)."""
+        if "event" not in record:
+            raise ValueError(
+                f"telemetry record needs an 'event' field: {record!r:.120}")
+        rec = dict(record)
+        with self._lock:
+            if self._closed:
+                raise ValueError("EventBus is closed")
+            rec.setdefault("schema_version", SCHEMA_VERSION)
+            rec["seq"] = self._seq
+            self._seq += 1
+            rec.setdefault("ts", round(self._clock(), 6))
+            if self._validate:
+                errors = validate_record(rec, strict=True)
+                if errors:
+                    raise ValueError(
+                        "invalid telemetry record: " + "; ".join(errors))
+            for ex in self._exporters:
+                ex.emit(rec)
+        return rec
+
+    def flush(self) -> None:
+        with self._lock:
+            for ex in self._exporters:
+                ex.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            for ex in self._exporters:
+                ex.close()

@@ -1,0 +1,254 @@
+"""The telemetry event catalog — typed, schema-versioned records.
+
+Every record on the bus is a flat JSON object with three envelope fields
+stamped by :class:`~gaussiank_sgd_tpu.telemetry.bus.EventBus`:
+
+    schema_version  int   — SCHEMA_VERSION at write time
+    seq             int   — monotonic per-run sequence number (0-based);
+                            a gap means records were dropped, a reset
+                            means two runs were concatenated into one file
+    ts              float — host unix time at publish
+
+plus an ``event`` discriminator naming one of the schemas below. Old
+readers keep working because new fields only ever ADD; readers of old
+files default absent envelope fields instead of failing (the satellite
+contract: schema_version defaults to 0 = "pre-telemetry", seq to None).
+
+The validator is deliberately tolerant of EXTRA fields: the ``train``
+event carries the model's auxiliary metrics (top1, perplexity, ...) whose
+names are model-specific, and forward-compatible readers must not reject
+fields they do not know.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+# envelope fields stamped by the bus on every record
+ENVELOPE_FIELDS = ("schema_version", "seq", "ts")
+
+# type vocabulary for schema entries (note bool is an int subclass — a
+# flag field declared NUMBER accepts True/False, which is intended)
+NUMBER: Tuple[type, ...] = (int, float)
+STRING: Tuple[type, ...] = (str,)
+ARRAY: Tuple[type, ...] = (list, tuple)
+
+
+@dataclass(frozen=True)
+class EventSchema:
+    """Field contract for one event kind. ``required`` fields must be
+    present with a matching type; ``optional`` fields are type-checked
+    only when present; unknown extra fields always pass (see module
+    docstring)."""
+
+    required: Mapping[str, Tuple[type, ...]]
+    optional: Mapping[str, Tuple[type, ...]] = field(default_factory=dict)
+
+
+EVENT_SCHEMAS: Dict[str, EventSchema] = {
+    # one per run, first record: the resolved operating point
+    "config": EventSchema(
+        required={"dnn": STRING, "dataset": STRING, "batch_size": NUMBER,
+                  "compressor": STRING, "density": NUMBER, "lr": NUMBER,
+                  "nworkers": NUMBER, "n_params": NUMBER,
+                  "total_steps": NUMBER},
+    ),
+    # per log interval: step metrics incl. the on-device comms accounting
+    "train": EventSchema(
+        required={"step": NUMBER, "epoch": NUMBER, "loss": NUMBER,
+                  "lr": NUMBER, "grad_norm": NUMBER,
+                  "num_selected": NUMBER, "bytes_sent": NUMBER,
+                  "density": NUMBER, "io_s": NUMBER, "step_s": NUMBER,
+                  "skipped": NUMBER, "nonfinite": NUMBER},
+        optional={"density_achieved": NUMBER, "ef_norm": NUMBER,
+                  "ex_per_s": NUMBER, "mfu": NUMBER,
+                  "sel_per_bucket": ARRAY, "consecutive_skips": NUMBER,
+                  "lr_scale": NUMBER, "fwd_bwd_s": NUMBER,
+                  "select_s": NUMBER, "comm_update_s": NUMBER,
+                  "phase_skipped": STRING},
+    ),
+    "eval": EventSchema(
+        required={"step": NUMBER, "epoch": NUMBER, "val_loss": NUMBER},
+        optional={"top1": NUMBER, "top5": NUMBER, "cer": NUMBER,
+                  "perplexity": NUMBER},
+    ),
+    # resilience runtime (docs/RESILIENCE.md)
+    "skip": EventSchema(
+        required={"step": NUMBER, "nonfinite": NUMBER},
+    ),
+    "rollback": EventSchema(
+        required={"reason": STRING, "rollback": NUMBER, "to_step": NUMBER,
+                  "lr_scale": NUMBER, "checkpoint": STRING},
+    ),
+    "restore_fallback": EventSchema(
+        required={"checkpoint": STRING, "error": STRING},
+    ),
+    "preempt": EventSchema(
+        required={"step": NUMBER, "checkpoint": STRING},
+    ),
+    "checkpoint": EventSchema(
+        required={"step": NUMBER, "path": STRING},
+    ),
+    # data loader retry (data/loader.py prefetch)
+    "io_retry": EventSchema(
+        required={"attempt": NUMBER, "max_retries": NUMBER,
+                  "backoff_s": NUMBER, "error": STRING},
+    ),
+    # jax.profiler trace-session hooks (telemetry/profiler.py)
+    "profile": EventSchema(
+        required={"action": STRING, "step": NUMBER, "logdir": STRING},
+    ),
+    # bench.py machine-readable records (one per model config)
+    "bench_model": EventSchema(
+        required={"key": STRING, "model": STRING, "dataset": STRING,
+                  "batch": NUMBER, "dense_step_ms": NUMBER,
+                  "sparse_step_ms": NUMBER, "ratio_median": NUMBER,
+                  "compressor": STRING},
+        optional={"ratio_min": NUMBER, "ratio_max": NUMBER,
+                  "mfu_dense": NUMBER, "mfu_sparse": NUMBER,
+                  "ex_per_s_chip": NUMBER},
+    ),
+    "bench_summary": EventSchema(
+        required={"metric": STRING, "value": NUMBER,
+                  "worst_config": STRING},
+    ),
+}
+
+
+def validate_record(record: Mapping[str, Any],
+                    strict: bool = False) -> List[str]:
+    """Schema-check one record; returns a list of problems (empty = ok).
+
+    Non-strict (the default) implements the compatible-reader contract:
+    absent envelope fields and unknown event kinds pass (old files, newer
+    writers). ``strict`` additionally requires the full envelope and a
+    known event kind — the mode the CI bench smoke validates freshly
+    written streams with.
+    """
+    errors: List[str] = []
+    event = record.get("event")
+    if not isinstance(event, str):
+        return [f"record has no string 'event' field: {record!r:.120}"]
+    sv = record.get("schema_version", 0)
+    if not isinstance(sv, int) or isinstance(sv, bool):
+        errors.append(f"schema_version must be an int, got {sv!r}")
+    elif sv > SCHEMA_VERSION:
+        errors.append(f"schema_version {sv} is newer than this reader "
+                      f"({SCHEMA_VERSION})")
+    seq = record.get("seq")
+    if seq is not None and (not isinstance(seq, int) or isinstance(seq, bool)
+                            or seq < 0):
+        errors.append(f"seq must be a non-negative int, got {seq!r}")
+    if strict:
+        for f_name in ENVELOPE_FIELDS:
+            if f_name not in record:
+                errors.append(f"{event}: missing envelope field {f_name!r}")
+    schema = EVENT_SCHEMAS.get(event)
+    if schema is None:
+        if strict:
+            errors.append(f"unknown event kind {event!r}")
+        return errors
+    for name, types in schema.required.items():
+        if name not in record:
+            errors.append(f"{event}: missing required field {name!r}")
+        elif record[name] is not None and not isinstance(record[name], types):
+            errors.append(
+                f"{event}.{name}: expected "
+                f"{'/'.join(t.__name__ for t in types)}, "
+                f"got {type(record[name]).__name__}")
+    for name, types in schema.optional.items():
+        if name in record and record[name] is not None and \
+                not isinstance(record[name], types):
+            errors.append(
+                f"{event}.{name}: expected "
+                f"{'/'.join(t.__name__ for t in types)}, "
+                f"got {type(record[name]).__name__}")
+    return errors
+
+
+@dataclass
+class StreamReport:
+    """Result of :func:`validate_stream` over one JSONL file/iterable."""
+
+    n_records: int = 0
+    events: Dict[str, int] = field(default_factory=dict)
+    errors: List[str] = field(default_factory=list)      # fatal problems
+    warnings: List[str] = field(default_factory=list)    # suspicious, legal
+    n_stamped: int = 0          # records carrying a seq number
+    seq_resets: int = 0         # seq went backwards (mixed-run file)
+    seq_gaps: int = 0           # seq jumped forward (dropped records)
+    truncated: bool = False     # file ends mid-record
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors and not self.truncated
+
+
+def validate_stream(lines: Iterable[str], strict: bool = False,
+                    max_errors: int = 50) -> StreamReport:
+    """Validate a JSONL event stream line by line.
+
+    Detects what the satellite contract asks parsers to detect: truncation
+    (a final non-JSON partial line), mixed-run files (seq resets), and
+    dropped records (seq gaps). Legacy records without seq/schema_version
+    are counted but not failed (non-strict mode).
+    """
+    rep = StreamReport()
+    prev_seq: Optional[int] = None
+    last_bad_line: Optional[int] = None
+    for i, line in enumerate(lines, start=1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            last_bad_line = i
+            if len(rep.errors) < max_errors:
+                rep.errors.append(f"line {i}: not valid JSON")
+            continue
+        last_bad_line = None
+        if not isinstance(record, dict):
+            if len(rep.errors) < max_errors:
+                rep.errors.append(f"line {i}: not a JSON object")
+            continue
+        rep.n_records += 1
+        ev = record.get("event")
+        key = ev if isinstance(ev, str) else "<missing>"
+        rep.events[key] = rep.events.get(key, 0) + 1
+        for msg in validate_record(record, strict=strict):
+            if len(rep.errors) < max_errors:
+                rep.errors.append(f"line {i}: {msg}")
+        seq = record.get("seq")
+        if isinstance(seq, int) and not isinstance(seq, bool):
+            rep.n_stamped += 1
+            if prev_seq is not None:
+                if seq < prev_seq:
+                    rep.seq_resets += 1
+                    rep.warnings.append(
+                        f"line {i}: seq reset {prev_seq} -> {seq} "
+                        f"(mixed-run file?)")
+                elif seq > prev_seq + 1:
+                    rep.seq_gaps += 1
+                    rep.warnings.append(
+                        f"line {i}: seq gap {prev_seq} -> {seq} "
+                        f"({seq - prev_seq - 1} record(s) missing)")
+            prev_seq = seq
+        elif strict and seq is None:
+            pass  # already reported as a missing envelope field above
+    if last_bad_line is not None:
+        # a bad FINAL line is truncation (a crash mid-write), not noise
+        rep.truncated = True
+        rep.errors.append(
+            f"stream ends with a partial record at line {last_bad_line} "
+            f"(truncated file)")
+    return rep
+
+
+def validate_file(path: str, strict: bool = False) -> StreamReport:
+    with open(path, "r", encoding="utf-8") as fh:
+        return validate_stream(fh, strict=strict)

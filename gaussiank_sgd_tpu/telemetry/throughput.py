@@ -1,0 +1,86 @@
+"""Rolling-window throughput / MFU tracker — skipped-step aware.
+
+The naive images/sec (global_batch / step_s) the reference logs LIES under
+the resilience runtime: a step the non-finite guard turned into a no-op
+took wall-clock time but trained on nothing, and a rollback rewinds the
+model so the window straddling it mixes two trajectories. This tracker
+owns both corrections:
+
+* a skipped step contributes its SECONDS but zero EXAMPLES (the time was
+  really spent; the work was discarded) — so throughput degrades honestly
+  under skips instead of reporting phantom images/sec;
+* :meth:`reset` empties the window — the trainer calls it on rollback so
+  post-restore throughput is measured on the new trajectory only.
+
+MFU uses the same convention: only useful (unskipped) steps count model
+FLOPs, against the chip's peak (benchlib.PEAK_FLOPS_BY_KIND).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+
+class ThroughputTracker:
+    """Rolling window of (examples, seconds, skipped) step samples."""
+
+    def __init__(self, window: int = 50):
+        if window <= 0:
+            raise ValueError(f"window must be positive, got {window}")
+        self.window = window
+        self._samples: deque = deque(maxlen=window)
+
+    def update(self, examples: float, seconds: float,
+               skipped: bool = False) -> None:
+        """Record one step. ``examples`` is the step's GLOBAL batch;
+        ``seconds`` its wall-clock (device + dispatch) time."""
+        if seconds < 0:
+            raise ValueError(f"negative step time {seconds}")
+        self._samples.append(
+            (0.0 if skipped else float(examples), float(seconds),
+             bool(skipped)))
+
+    def reset(self) -> None:
+        """Forget the window (trainer: on rollback — the restored
+        trajectory must not average against the diverged one)."""
+        self._samples.clear()
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(s for _, s, _ in self._samples)
+
+    @property
+    def skipped_in_window(self) -> int:
+        return sum(1 for _, _, sk in self._samples if sk)
+
+    @property
+    def examples_per_s(self) -> Optional[float]:
+        """Useful examples per wall-clock second over the window; None
+        until a sample with nonzero time exists."""
+        secs = self.total_seconds
+        if not self._samples or secs <= 0:
+            return None
+        return sum(e for e, _, _ in self._samples) / secs
+
+    @property
+    def steps_per_s(self) -> Optional[float]:
+        """UNSKIPPED steps per second (skips burn time, produce nothing)."""
+        secs = self.total_seconds
+        if not self._samples or secs <= 0:
+            return None
+        useful = sum(1 for _, _, sk in self._samples if not sk)
+        return useful / secs
+
+    def mfu(self, flops_per_step: Optional[float],
+            peak_flops: Optional[float]) -> Optional[float]:
+        """Model-FLOPs utilization over the window: useful-step FLOPs /
+        (elapsed * peak). None when FLOPs/peak are unknown (CPU) or the
+        window is empty."""
+        sps = self.steps_per_s
+        if not flops_per_step or not peak_flops or sps is None:
+            return None
+        return flops_per_step * sps / peak_flops

@@ -112,6 +112,11 @@ class TrainConfig:
     save_every_epochs: int = 10
     resume: Optional[str] = None            # checkpoint dir to resume from
     profile_steps: Optional[Tuple[int, int]] = None  # jax.profiler window
+    prom_textfile: Optional[str] = None     # Prometheus textfile-collector
+                                            # path (telemetry exporter);
+                                            # None = JSONL only
+    telemetry_window: int = 50              # rolling window (steps) for the
+                                            # throughput/MFU tracker
     phase_timing: bool = False              # fwd/bwd + select + comm ms in
                                             # every log line (the reference's
                                             # per-interval io/fwd/bwd/comm
@@ -249,6 +254,17 @@ def add_args(p: argparse.ArgumentParser, suppress_defaults: bool = False) -> Non
     p.add_argument("--save-every-epochs", dest="save_every_epochs", type=int,
                    default=d.save_every_epochs)
     p.add_argument("--resume", default=None)
+    p.add_argument("--profile-steps", dest="profile_steps", type=int,
+                   nargs=2, metavar=("START", "STOP"), default=None,
+                   help="arm a jax.profiler trace for global steps "
+                        "[START, STOP) (docs/OBSERVABILITY.md)")
+    p.add_argument("--prom-textfile", dest="prom_textfile", default=None,
+                   help="write latest metrics as a Prometheus "
+                        "node-exporter textfile at this path")
+    p.add_argument("--telemetry-window", dest="telemetry_window", type=int,
+                   default=d.telemetry_window,
+                   help="rolling window (steps) for the throughput/MFU "
+                        "tracker")
     p.add_argument("--model-kwargs", dest="model_kwargs", type=json.loads,
                    default={}, help='JSON, e.g. \'{"hidden_dim": 64}\'')
     p.add_argument("--dataset-kwargs", dest="dataset_kwargs", type=json.loads,
@@ -271,7 +287,14 @@ def from_args(args: argparse.Namespace,
     value still overrides the file.
     """
     fields = {f.name for f in dataclasses.fields(TrainConfig)}
-    base = {k: v for k, v in vars(args).items() if k in fields}
+
+    def _detuple(d: dict) -> dict:
+        # argparse nargs and JSON both deliver lists; tuple-typed fields
+        # (profile_steps, lr_milestones) normalize here
+        return {k: tuple(v) if isinstance(v, list) else v
+                for k, v in d.items()}
+
+    base = _detuple({k: v for k, v in vars(args).items() if k in fields})
     cfg_path = getattr(args, "config", None)
     if not cfg_path:
         return TrainConfig(**base)
@@ -300,5 +323,6 @@ def from_args(args: argparse.Namespace,
     explicit, _ = explicit_p.parse_known_args(argv)
     merged = dict(base)
     merged.update(file_vals)
-    merged.update({k: v for k, v in vars(explicit).items() if k in fields})
+    merged.update(_detuple(
+        {k: v for k, v in vars(explicit).items() if k in fields}))
     return TrainConfig(**merged)

@@ -9,13 +9,13 @@ bytes-sent/density — alongside the human-readable lines.
 
 from __future__ import annotations
 
-import json
 import logging
 import os
 import sys
-import threading
 import time
 from typing import Any, Dict, Optional
+
+from ..telemetry.exporters import JSONLExporter
 
 
 def make_logger(name: str = "gaussiank_sgd_tpu",
@@ -38,34 +38,18 @@ def make_logger(name: str = "gaussiank_sgd_tpu",
     return logger
 
 
-class JSONLWriter:
-    """Append-only JSONL metric stream (one dict per record).
+class JSONLWriter(JSONLExporter):
+    """Back-compat alias for :class:`telemetry.exporters.JSONLExporter`.
 
-    Thread-safe: the train loop writes from the main thread while the
-    prefetch thread reports ``io_retry`` events (data/loader.py), so the
-    dump+write pair is serialized under a lock — interleaved half-lines
-    would corrupt the stream for every downstream parser.
+    The trainer now publishes through ``telemetry.EventBus`` (which stamps
+    schema_version/seq/ts); this shim keeps the historical
+    ``JSONLWriter(path).write(record)`` surface for external callers and
+    old analysis scripts. Same thread-safety contract: the dump+write pair
+    is serialized under a lock.
     """
 
-    def __init__(self, path: Optional[str]):
-        self.path = path
-        self._f = None
-        self._lock = threading.Lock()
-        if path:
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            self._f = open(path, "a", buffering=1)
-
     def write(self, record: Dict[str, Any]) -> None:
-        line = json.dumps(record, default=float) + "\n"
-        with self._lock:
-            if self._f:
-                self._f.write(line)
-
-    def close(self) -> None:
-        with self._lock:
-            if self._f:
-                self._f.close()
-                self._f = None
+        self.emit(record)
 
 
 class PhaseTimers:

@@ -10,7 +10,8 @@ Responsibilities, mapped from the reference:
   warm-up dense allreduce   -> Python-side dense/sparse step selection
   train/test loops, timers  -> Trainer.train / Trainer.test / PhaseTimers
   checkpoints               -> training.checkpoint (orbax, full state)
-  metrics/logging           -> JSONL + human log lines
+  metrics/logging           -> telemetry.EventBus (JSONL/Prometheus
+                               exporters) + human log lines
 
 Everything device-side lives in ONE jitted SPMD program per step kind; the
 trainer is a thin host loop feeding batches and draining metrics
@@ -44,9 +45,12 @@ from .checkpoint import (gc_checkpoints, restore_checkpoint,
 from .config import TrainConfig
 from .losses import make_eval_fn, make_loss_fn
 from .lr_schedule import warmup_milestone_schedule
-from .metrics import JSONLWriter, PhaseTimers, make_logger
+from .metrics import PhaseTimers, make_logger
 from .resilience import (GracefulShutdown, ResilienceMonitor,
                          ResiliencePolicy, TrainingPreempted)
+from ..telemetry import (EventBus, JSONLExporter,
+                         PrometheusTextfileExporter, ThroughputTracker)
+from ..telemetry.profiler import ProfilerSession
 
 
 def _dtype_of(name: str):
@@ -67,7 +71,19 @@ class Trainer:
         run_dir = os.path.join(cfg.output_dir, cfg.run_id)
         self.run_dir = run_dir
         self.logger = make_logger(log_file=os.path.join(run_dir, "train.log"))
-        self.jsonl = JSONLWriter(os.path.join(run_dir, "metrics.jsonl"))
+        # telemetry spine (docs/OBSERVABILITY.md): every runtime event —
+        # train intervals, loader io_retry (prefetch thread), resilience
+        # skip/rollback/preempt, checkpoints, profiler windows — goes
+        # through ONE bus that stamps schema_version/seq/ts and fans out
+        # to the attached exporters in publish order
+        exporters = [JSONLExporter(os.path.join(run_dir, "metrics.jsonl"))]
+        if cfg.prom_textfile:
+            exporters.append(PrometheusTextfileExporter(cfg.prom_textfile))
+        self.bus = EventBus(exporters)
+        self.tracker = ThroughputTracker(window=cfg.telemetry_window)
+        self._flops_per_step: Optional[float] = None
+        self._peak_flops: Optional[float] = None
+        self._mfu_probed = False
         self.timers = PhaseTimers()
         # phase-breakdown compile hygiene (ADVICE r4): programs whose first
         # dispatch (= jit compile) already happened, and whether the current
@@ -241,11 +257,18 @@ class Trainer:
             cfg.dnn, cfg.dataset, n_params / 1e6, self.nworkers,
             local_bs, comp.name, cfg.density, len(plan.buckets),
             plan.total_k, self.steps_per_epoch, self.total_steps)
-        self.jsonl.write({"event": "config", **{
+        self.bus.publish({"event": "config", **{
             k: getattr(cfg, k) for k in ("dnn", "dataset", "batch_size",
                                          "compressor", "density", "lr")},
             "nworkers": self.nworkers, "n_params": n_params,
             "total_steps": self.total_steps})
+        # jax.profiler trace window, armed for cfg.profile_steps — the
+        # session owns start/stop state and records the covered steps as
+        # `profile` events on the bus (telemetry/profiler.py)
+        self.profiler = (ProfilerSession(
+            os.path.join(run_dir, "profile"), cfg.profile_steps[0],
+            cfg.profile_steps[1], bus=self.bus, logger=self.logger)
+            if cfg.profile_steps else None)
 
     # ------------------------------------------------------------------
     def _build_steps(self) -> None:
@@ -351,6 +374,7 @@ class Trainer:
         path = save_checkpoint(self.ckpt_dir, self._state,
                                overwrite=step not in self._saved_steps)
         self._saved_steps.add(step)
+        self.bus.publish({"event": "checkpoint", "step": step, "path": path})
         if self.cfg.keep_checkpoints:
             removed = gc_checkpoints(self.ckpt_dir,
                                      self.cfg.keep_checkpoints)
@@ -361,7 +385,7 @@ class Trainer:
     def _log_restore_skip(self, path: str, exc: Exception) -> None:
         self.logger.warning("restore fallback: skipping %s (%s: %s)",
                             path, type(exc).__name__, exc)
-        self.jsonl.write({"event": "restore_fallback", "checkpoint": path,
+        self.bus.publish({"event": "restore_fallback", "checkpoint": path,
                           "error": f"{type(exc).__name__}: {exc}"})
 
     def _rollback(self, reason: str) -> None:
@@ -401,9 +425,12 @@ class Trainer:
                 f"{self.ckpt_dir!r} — enable save_every_steps so a "
                 f"rollback target exists (docs/RESILIENCE.md)") from e
         to_step = int(jax.device_get(state.step))
-        self.jsonl.write({"event": "rollback", "reason": reason,
+        self.bus.publish({"event": "rollback", "reason": reason,
                           "rollback": n, "to_step": to_step,
                           "lr_scale": self._lr_scale, "checkpoint": path})
+        # the rewound steps' timings describe the abandoned trajectory;
+        # post-rollback throughput must not average them in
+        self.tracker.reset()
         self.logger.warning(
             "rollback #%d (%s): restored %s (step %d), lr_scale=%g",
             n, reason, path, to_step, self._lr_scale)
@@ -438,21 +465,6 @@ class Trainer:
             # resolved per iteration: a rollback mid-run invalidates the
             # cached iterator, and the rebuilt one must be picked up here
             it = data_iter if data_iter is not None else self._train_iter()
-            # jax.profiler trace window (SURVEY.md §5 Tracing rebuild note:
-            # real fwd/bwd/comm breakdown comes from device traces, not
-            # host timers). cfg.profile_steps = (start, stop).
-            if cfg.profile_steps:
-                s = self.step
-                if s == cfg.profile_steps[0]:
-                    jax.profiler.start_trace(
-                        os.path.join(self.run_dir, "profile"))
-                    self._profiling = True
-                elif s >= cfg.profile_steps[1] and getattr(
-                        self, "_profiling", False):
-                    jax.profiler.stop_trace()
-                    self._profiling = False
-                    self.logger.info("profiler trace -> %s",
-                                     os.path.join(self.run_dir, "profile"))
             self.timers.start("io")
             batch = next(it)
             batch = shard_batch(self.mesh, batch, spec=self._batch_spec)
@@ -460,6 +472,11 @@ class Trainer:
             self.timers.start("step")
             step = self.step if not hasattr(self, "_step_cache") else \
                 self._step_cache
+            if self.profiler is not None:
+                # jax.profiler trace window (SURVEY.md §5 Tracing rebuild
+                # note: real fwd/bwd/comm breakdown comes from device
+                # traces, not host timers); cached step — no device sync
+                self.profiler.maybe_transition(step)
             if (self.recurrent and step % self.steps_per_epoch == 0
                     and step > 0):
                 # fresh text stream at each epoch wrap -> fresh carry
@@ -481,25 +498,33 @@ class Trainer:
                 if key not in self._dispatched_fns:
                     self._dispatched_fns.add(key)
                     self._interval_has_compile = True
+            t_step0 = time.perf_counter()
             self._state, m = fn(self._state, batch)
             # jit dispatch is async: sync before stopping the timer so
             # step_s/ex-s measure device work, not dispatch latency
             jax.block_until_ready(m.loss)
+            step_wall = time.perf_counter() - t_step0
             self._step_cache = step + 1
             self.timers.stop()
             losses.append(m)
             done = step + 1
+            # m.loss is already synced above, so these per-step host reads
+            # cost a device_get of ready scalars, not a sync. Guard-off
+            # runs skip the read: skipped is a structural zero there.
+            sk = (float(jax.device_get(m.skipped))
+                  if cfg.nonfinite_guard else 0.0)
+            # skipped steps burn wall-clock but train on nothing — they
+            # must not inflate ex/s (telemetry/throughput.py)
+            self.tracker.update(cfg.global_batch_size, step_wall,
+                                skipped=bool(sk))
+            if sk:
+                nf = float(jax.device_get(m.nonfinite))
+                self.bus.publish({"event": "skip", "step": done,
+                                  "nonfinite": nf})
+                self.logger.warning(
+                    "step %d skipped by in-step guard (%g non-finite "
+                    "grad entries); state unchanged", done, nf)
             if self.monitor is not None:
-                # m.loss is already synced above, so these per-step host
-                # reads cost a device_get of two ready scalars, not a sync
-                sk = float(jax.device_get(m.skipped))
-                if sk:
-                    nf = float(jax.device_get(m.nonfinite))
-                    self.jsonl.write({"event": "skip", "step": done,
-                                      "nonfinite": nf})
-                    self.logger.warning(
-                        "step %d skipped by in-step guard (%g non-finite "
-                        "grad entries); state unchanged", done, nf)
                 self.monitor.observe(done, float(jax.device_get(m.loss)),
                                      sk)
             pending = (self.monitor.should_rollback()
@@ -519,7 +544,7 @@ class Trainer:
                 # preemption contract (docs/RESILIENCE.md): seal a
                 # checkpoint at the step boundary, then exit cleanly
                 path = self._save_checkpoint()
-                self.jsonl.write({"event": "preempt", "step": done,
+                self.bus.publish({"event": "preempt", "step": done,
                                   "checkpoint": path})
                 self.logger.warning(
                     "shutdown requested: checkpointed %s at step %d",
@@ -547,8 +572,8 @@ class Trainer:
         return self._iter
 
     def _io_event(self, rec: Dict[str, Any]) -> None:
-        # runs on the prefetch thread; JSONLWriter is lock-serialized
-        self.jsonl.write(rec)
+        # runs on the prefetch thread; EventBus.publish is lock-serialized
+        self.bus.publish(rec)
         self.logger.warning(
             "data io retry %s/%s after %s (backoff %.3gs)",
             rec.get("attempt"), rec.get("max_retries"), rec.get("error"),
@@ -614,6 +639,27 @@ class Trainer:
             out["comm_update_s"] = round(max(step_s - t_grads, 0.0), 6)
         return out
 
+    def _maybe_probe_mfu(self, fn) -> None:
+        """Resolve flops/step + device peak once (lazily, off the first
+        logged interval) so the tracker can report MFU. TPU-only in
+        practice: ``device_peak_flops`` is None elsewhere and the probe is
+        skipped; cost-analysis failures degrade to no-MFU, never kill the
+        run."""
+        if self._mfu_probed or getattr(self, "_probe_batch", None) is None:
+            return
+        self._mfu_probed = True
+        from ..benchlib import device_peak_flops, program_flops
+        self._peak_flops = device_peak_flops()
+        if self._peak_flops is None:
+            return
+        try:
+            self._flops_per_step = program_flops(fn, self._state,
+                                                 self._probe_batch)
+        except Exception as e:                        # noqa: BLE001
+            self.logger.warning("mfu probe failed (%s: %s); mfu disabled",
+                                type(e).__name__, e)
+            self._peak_flops = None
+
     def _log_train(self, step: int, m, quiet: bool = False):
         loss = float(jax.device_get(m.loss))
         means = self.timers.means()
@@ -625,10 +671,26 @@ class Trainer:
             "num_selected": float(jax.device_get(m.num_selected)),
             "bytes_sent": int(jax.device_get(m.bytes_sent)),
             "density": self.cfg.density,
+            "density_achieved": float(jax.device_get(m.achieved_density)),
+            "ef_norm": float(jax.device_get(m.ef_norm)),
             "io_s": means.get("io", 0.0), "step_s": means.get("step", 0.0),
             "skipped": float(jax.device_get(m.skipped)),
             "nonfinite": float(jax.device_get(m.nonfinite)),
         }
+        if len(self.plan.buckets) > 1:
+            # per-bucket selection counts (dp-mean); single-bucket plans
+            # skip the column — it would duplicate num_selected
+            rec["sel_per_bucket"] = [
+                round(float(v), 2)
+                for v in np.asarray(jax.device_get(m.sel_per_bucket))]
+        ex_s = self.tracker.examples_per_s
+        if ex_s is not None:
+            rec["ex_per_s"] = round(ex_s, 3)
+        self._maybe_probe_mfu(self.ts.dense_step if self._in_warmup(step)
+                              else self.ts.sparse_step)
+        mfu = self.tracker.mfu(self._flops_per_step, self._peak_flops)
+        if mfu is not None:
+            rec["mfu"] = round(mfu, 5)
         if self.monitor is not None:
             rec["consecutive_skips"] = self.monitor.consecutive_skips
             rec["lr_scale"] = self._lr_scale
@@ -636,7 +698,7 @@ class Trainer:
             rec.update(self._phase_breakdown(rec["step_s"]))
         aux = jax.device_get(m.aux)
         rec.update({k: float(v) for k, v in aux.items()})
-        self.jsonl.write(rec)
+        self.bus.publish(rec)
         if not quiet:
             imgs = self.cfg.global_batch_size / max(rec["step_s"], 1e-9)
             phases = ""
@@ -692,7 +754,7 @@ class Trainer:
             out["perplexity"] = math.exp(min(out["val_loss"], 30.0))
         rec = {"event": "eval", "step": self.step,
                "epoch": epoch if epoch is not None else self.epoch, **out}
-        self.jsonl.write(rec)
+        self.bus.publish(rec)
         self.logger.info("eval %s", " ".join(
             f"{k}={v:.4f}" for k, v in out.items()))
         return out
@@ -740,4 +802,6 @@ class Trainer:
         return result
 
     def close(self):
-        self.jsonl.close()
+        if self.profiler is not None:
+            self.profiler.close()      # stop a still-live trace first
+        self.bus.close()

@@ -258,6 +258,47 @@ def test_fail_loud_quiet_on_typed_except_and_raise():
     assert found == []
 
 
+# ------------------------------------------------------- print-in-library
+
+def test_print_in_library_flags_bare_print():
+    found = run("""
+        def report(x):
+            print("value:", x)
+            return x
+        """, rule="print-in-library")
+    assert len(found) == 1
+    assert found[0].severity == "warning"
+    assert "stdout" in found[0].message
+
+
+def test_print_in_library_allows_main_py_and_main_guard():
+    src = """
+        def report(x):
+            print(x)
+
+        if __name__ == "__main__":
+            print("script mode")
+        """
+    # CLI entrypoint files are allowlisted wholesale
+    assert run(src, rule="print-in-library", path="__main__.py") == []
+    # elsewhere, only the __main__-guarded print passes
+    found = run(src, rule="print-in-library", path="lib.py")
+    assert len(found) == 1
+    assert found[0].line == 3
+
+
+def test_print_in_library_quiet_on_logger_and_shadowed_print():
+    found = run("""
+        import logging
+
+        def report(x, print=None):        # locally bound callables still
+            log = logging.getLogger(__name__)   # match by name: acceptable
+            log.info("value: %s", x)
+            return x
+        """, rule="print-in-library")
+    assert found == []
+
+
 # ------------------------------------------------------------- suppression
 
 def test_trailing_suppression_comment():
@@ -369,12 +410,12 @@ def test_cli_clean_after_write_baseline(tmp_path):
     assert json.loads(r.stdout)["counts"]["new"] == 1
 
 
-def test_cli_list_rules_names_all_six():
+def test_cli_list_rules_names_all_seven():
     r = _cli("--list-rules")
     assert r.returncode == 0
     for rule in ALL_RULES:
         assert rule.name in r.stdout
-    assert len(ALL_RULES) == 6
+    assert len(ALL_RULES) == 7
 
 
 def test_package_is_clean_against_committed_baseline():
