@@ -22,8 +22,12 @@ full array the primitive touches — a loop-carried dependence XLA cannot
 hoist or DCE — and fences through a scalar ``float()``. Reported ms =
 (loop time)/n_steps, median over rounds.
 
-Artifact: analysis/artifacts/overhead_microbench.json
-Run (TPU): python analysis/overhead_microbench.py
+Artifact: analysis/artifacts/overhead_microbench.json (57M default);
+``--config config2|config4`` re-prices every primitive at that BASELINE
+config's own gradient size (the r6 gap: the binding vgg16 config was
+never profiled at its own ~15M scale) and writes
+overhead_microbench_<config>.json; ``--tag`` overrides the suffix.
+Run (TPU): python analysis/overhead_microbench.py [--config config2]
 """
 
 from __future__ import annotations
@@ -41,21 +45,46 @@ sys.path.insert(0, REPO)
 ARTIFACTS = os.path.join(REPO, "analysis", "artifacts")
 
 
+# bench.py config key -> (model, dataset); n is resolved to the model's
+# actual param count at runtime (roofline.param_count), so the microbench
+# scale can never drift from what the bench measures
+CONFIG_MODELS = {
+    "config2": ("vgg16", "cifar10"),
+    "config4": ("lstm", "ptb"),
+    "config5": ("transformer", "wmt"),
+}
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--n", type=int, default=57_000_000)
+    p.add_argument("--config", choices=sorted(CONFIG_MODELS),
+                   help="price the primitives at this BASELINE config's "
+                        "own param count instead of --n")
+    p.add_argument("--tag", default=None,
+                   help="artifact suffix: overhead_microbench_<tag>.json "
+                        "(defaults to --config when given)")
     p.add_argument("--density", type=float, default=0.001)
     p.add_argument("--n-steps", type=int, default=20)
     p.add_argument("--rounds", type=int, default=5)
     args = p.parse_args()
+
+    config_model = None
+    if args.config:
+        from roofline import param_count
+        config_model = CONFIG_MODELS[args.config]
+        args.n = param_count(*config_model)
+        if args.tag is None:
+            args.tag = args.config
 
     import jax
     import jax.numpy as jnp
     import optax
     from jax import lax
 
-    from gaussiank_sgd_tpu.ops.pallas_pack import (_chunk_geometry,
-                                                   fused_select_candidates)
+    from gaussiank_sgd_tpu.ops.pallas_pack import (
+        _chunk_geometry, ef_padded_chunk, fused_ef_select_candidates_chunked,
+        fused_select_candidates)
 
     n, k = args.n, int(args.n * args.density)
     key = jax.random.PRNGKey(0)
@@ -99,6 +128,24 @@ def main():
     ms["kernel_pass"] = round(ms["kernel_pass_incl_scale"]
                               - ms["scale_only"], 3)
 
+    # the single-pass fused EF+select form (ops/pallas_pack.py): reads
+    # residual + grad, writes the accumulator, emits candidates in ONE
+    # kernel. Compare against ef_accumulate + kernel_pass — the two
+    # n-sized passes it replaces.
+    cp = ef_padded_chunk(n, k, density=args.density)
+    if cp is not None:
+        g_pad = jnp.pad(grad, (0, cp - n)).reshape(1, cp)
+        thr = jnp.full((1,), 3.0, jnp.float32)
+
+        def fused_ef_body(res):
+            a2, _vals, _idxs, counts = fused_ef_select_candidates_chunked(
+                res, g_pad, jnp.float32(1e-6), thr, args.density)
+            # fold count back so the candidate emission cannot be DCE'd;
+            # the tiny grad scale keeps the loop-carried residual finite
+            return a2 + (counts[0].astype(jnp.float32) * jnp.float32(0.0))
+        ms["fused_ef_select_pass"] = timeit(
+            fused_ef_body, jnp.pad(acc, (0, cp - n)).reshape(1, cp))
+
     def topk_body(c):
         kv, ki = lax.top_k(jnp.abs(c), k)
         return c.at[ki[0]].add(kv[0] * jnp.float32(1e-12))
@@ -139,14 +186,18 @@ def main():
 
     res = {
         "shapes": {"n": n, "k": k, "candidates": nc},
+        "config": ({"key": args.config, "model": config_model[0],
+                    "dataset": config_model[1]} if config_model else None),
         "method": f"fori_loop x{args.n_steps} per dispatch, loop-carried "
                   f"arrays, scalar fence; median of {args.rounds} rounds",
         "ms": ms,
+        "platform": jax.devices()[0].platform,
         "device": str(jax.devices()[0].device_kind),
     }
     os.makedirs(ARTIFACTS, exist_ok=True)
-    with open(os.path.join(ARTIFACTS, "overhead_microbench.json"),
-              "w") as f:
+    name = ("overhead_microbench.json" if not args.tag
+            else f"overhead_microbench_{args.tag}.json")
+    with open(os.path.join(ARTIFACTS, name), "w") as f:
         json.dump(res, f, indent=2)
     print(json.dumps(res))
 

@@ -91,6 +91,40 @@ def paired_delta_ms(rounds: dict, a: str, b: str) -> Optional[float]:
     return round(statistics.median(pairs), 3)
 
 
+def noise_floored_delta_ms(rounds: dict, a: str, b: str) -> Optional[float]:
+    """``paired_delta_ms`` that never reports a negative duration.
+
+    A phase delta is a DURATION — a physical quantity that cannot be
+    negative. The paired-median estimator still goes slightly negative
+    when the true delta is smaller than the per-round timing noise (the
+    r5 matrix printed select_pack_ms = -0.1 for cells where select+pack
+    is cheaper than one round's jitter — VERDICT r5 weak #5). The honest
+    report for such a cell is "below measurement noise", not a negative
+    number that a reader must know to discard.
+
+    Rule: returns the paired median when it exceeds the noise floor —
+    the median absolute deviation of the per-round paired deltas (the
+    same samples, so the floor tracks the actual round-to-round jitter
+    of this cell, not a global constant) — and None otherwise. Callers
+    render None as "< noise". Single-round runs have no dispersion
+    estimate, so only the sign rule applies there.
+    """
+    import statistics
+
+    ra, rb = rounds.get(a, []), rounds.get(b, [])
+    if not ra or len(ra) != len(rb):
+        return None
+    pairs = [1e3 * (x - y) for x, y in zip(ra, rb)]
+    med = statistics.median(pairs)
+    if med <= 0:
+        return None
+    if len(pairs) >= 2:
+        mad = statistics.median([abs(p - med) for p in pairs])
+        if med <= mad:
+            return None
+    return round(med, 3)
+
+
 def ablation_specs():
     """Probe compressors that run a PREFIX of the sparse pipeline, for
     drift-free phase decomposition (VERDICT r3 item 6; the reference

@@ -47,6 +47,16 @@ class CompressorSpec(NamedTuple):
     # select under vmap and runs BOTH branches — ADVICE r2 medium); the
     # batched form hoists such decisions to scalar predicates.
     batched_fn: Optional[Callable] = None
+    # Optional fused EF+select form: (res2d, g2d, scale, k, state) ->
+    # (CompressResult, new_state) where res2d/g2d are PRE-PADDED
+    # [n_chunks, chunk_pad] views and the EF accumulate happens inside the
+    # kernel's single HBM pass (ops/pallas_pack.py). The train step takes
+    # this path only when ``ef_pad`` blesses the plan geometry (see
+    # parallel/trainstep.py build-time gate).
+    fused_ef_fn: Optional[Callable] = None
+    # (chunk, k) -> padded chunk size the fused EF kernel needs, or None
+    # when the fused path can't serve that geometry (density/capacity).
+    ef_pad: Optional[Callable[[int, int], Optional[int]]] = None
 
 
 def get_compressor(name: str, *, density: float = 0.001,
@@ -98,8 +108,10 @@ def get_compressor(name: str, *, density: float = 0.001,
         # too (VERDICT r4 item 3): the chunked form grids over chunks with
         # per-chunk SMEM thresholds instead of vmapping the sequential
         # grid (gaussian_fused_compress_batched).
-        from ..ops.pallas_pack import (gaussian_fused_compress,
+        from ..ops.pallas_pack import (ef_padded_chunk,
+                                       gaussian_fused_compress,
                                        gaussian_fused_compress_batched,
+                                       gaussian_fused_ef_compress_batched,
                                        supports_density)
         if not supports_density(density):
             bfn = functools.partial(gaussian_warm_compress_batched,
@@ -119,8 +131,15 @@ def get_compressor(name: str, *, density: float = 0.001,
                                sigma_scale=sigma_scale)
         bfn = functools.partial(gaussian_fused_compress_batched,
                                 density=density, sigma_scale=sigma_scale)
+        # single-pass EF+select form (the throughput-contract path): the
+        # train step routes through it when the plan geometry allows a
+        # pre-padded live EF buffer (ef_pad != None for every chunk)
+        effn = functools.partial(gaussian_fused_ef_compress_batched,
+                                 density=density, sigma_scale=sigma_scale)
+        epad = functools.partial(ef_padded_chunk, density=density)
         return CompressorSpec("gaussian_fused", fn, False, True,
-                              lambda k: k, stateful=True, batched_fn=bfn)
+                              lambda k: k, stateful=True, batched_fn=bfn,
+                              fused_ef_fn=effn, ef_pad=epad)
     if name in ("gaussian_pallas", "gaussianp"):
         # same selection contract as 'gaussian', threshold found by the
         # 3-pass Pallas kernel estimator (ops/pallas_select.py, SURVEY §7

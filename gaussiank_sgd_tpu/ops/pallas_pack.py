@@ -33,9 +33,24 @@ TPU-shaped two-level scheme:
      buffer plus the (tiny) candidate tiles.
   2. **In-XLA (small)**: the candidate buffer has ``nc = n/SEG`` slots
      (64x smaller than the gradient at the contract density), so a top-k
-     over candidate magnitudes — exact ``lax.top_k`` up to 128k
-     candidates (``_EXACT_CAND_MAX`` = 1<<17), ``approx_max_k`` beyond
-     (misses defer to EF) — picks the final k pairs in f32.
+     over candidate magnitudes — exact ``lax.top_k`` up to
+     {EXACT_CAND_MAX_K}k candidates (``_EXACT_CAND_MAX``),
+     ``approx_max_k`` beyond (misses defer to EF) — picks the final k
+     pairs in f32.
+
+The fused **EF+select** form (``_ef_select_kernel`` /
+``gaussian_fused_ef_compress_batched``) additionally folds the error-
+feedback accumulate into the same HBM pass: the kernel reads the carried
+residual and the new gradient, writes ``acc = residual + scale*grad``, and
+emits the candidates of that acc — 3 n-sized transfers per step (read res,
+read grad, write acc) instead of the 5+ of the unfused
+accumulate-then-select pipeline. It requires the caller to keep a
+PRE-PADDED live EF buffer (chunks block-aligned via ``ef_padded_chunk``)
+so the kernel pass needs no ``jnp.pad`` copy; padding is stripped at the
+checkpoint/elastic edges (training/checkpoint.py). The pad region is
+provably inert: thresholds are always >= 0, the select mask is strict
+``|x| > t``, and the pad starts (and therefore stays) zero, so no pad
+element is ever selected and the residual pad remains zero forever.
 
 Selection contract vs ``pack_by_mask(priority="magnitude")``: identical mask
 (``|acc| > t``), identical exact EF bookkeeping (the caller zeroes exactly
@@ -156,6 +171,37 @@ def _select_kernel(x_ref, t_ref, val_ref, idx_ref, count_ref, *,
     count), one row per chunk, carried across the chunk's sequential
     blocks.
     """
+    x = x_ref[:]
+    _emit_candidates(x, t_ref, val_ref, idx_ref, count_ref,
+                     rows=rows, seg=seg)
+
+
+def _ef_select_kernel(res_ref, g_ref, scale_ref, t_ref,
+                      acc_ref, val_ref, idx_ref, count_ref, *,
+                      rows: int, seg: int):
+    """The fused EF+select grid step: acc = res + scale*grad, candidates of
+    that acc — one HBM pass over both n-sized inputs and the n-sized output.
+
+    Identical candidate contract to :func:`_select_kernel` (shared body,
+    ``_emit_candidates``); the only addition is the EF accumulate. The
+    caller persists ``acc_ref`` as the NEW EF buffer and later zeroes the
+    k sent entries (finish_pack), exactly as in the unfused path.
+
+    res_ref/g_ref/acc_ref: [R, 128] f32 blocks (grad pre-cast by the
+    wrapper — the kernel is f32-only, matching the accumulate dtype).
+    scale_ref: [1, 1] f32 SMEM — the grad scale (folded LR or 1).
+    """
+    acc = res_ref[:] + scale_ref[0, 0] * g_ref[:]
+    acc_ref[:] = acc
+    _emit_candidates(acc, t_ref, val_ref, idx_ref, count_ref,
+                     rows=rows, seg=seg)
+
+
+def _emit_candidates(x, t_ref, val_ref, idx_ref, count_ref, *,
+                     rows: int, seg: int):
+    """Candidate-emission body shared by the select-only and EF+select
+    kernels: largest above-threshold entry per (segment, lane) of the
+    in-register block ``x``, plus the exact above-threshold count."""
     c = pl.program_id(0)
     i = pl.program_id(1)
 
@@ -163,7 +209,6 @@ def _select_kernel(x_ref, t_ref, val_ref, idx_ref, count_ref, *,
     def _init():
         count_ref[c, 0] = 0
 
-    x = x_ref[:]
     ax = jnp.abs(x)
     t = t_ref[c, 0]
     mask = ax > t
@@ -259,6 +304,99 @@ def fused_select_candidates_chunked(
             counts[:, 0])
 
 
+def ef_padded_chunk(chunk: int, k: int, *,
+                    density: float) -> Optional[int]:
+    """Block-aligned chunk size the fused EF+select kernel needs, or None
+    when the fused-EF path cannot serve this (chunk, k, density).
+
+    The fused kernel keeps the EF buffer PRE-PADDED so its HBM pass needs
+    no copy: each chunk's live size must be ``blocks_per_chunk * R * 128``.
+    For a single whole-model bucket that is a pure suffix pad; a uniform
+    plan is eligible iff its chunk is already block-aligned (returned value
+    == chunk) — otherwise the in-chunk pad would shift every following
+    chunk's global offsets and the caller must keep the unfused path.
+
+    Returns None (caller falls back to the unfused path) when the density
+    is above the geometry ceiling or k exceeds the candidate capacity —
+    the same conditions under which ``gaussian_fused_compress_batched``
+    would route to the XLA warm path."""
+    if not supports_density(density):
+        return None
+    R, _, bpc, nc = _chunk_geometry(chunk, density)
+    if k > nc:
+        return None
+    return bpc * R * _LANES
+
+
+def fused_ef_select_candidates_chunked(
+    res2d: jax.Array, g2d: jax.Array, scale: jax.Array,
+    thresholds: jax.Array, density: float,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fused EF accumulate + candidate pass over pre-padded
+    ``[n_chunks, chunk_pad]`` buffers with PER-CHUNK thresholds.
+
+    Returns ``(acc2d [n_chunks, chunk_pad], cand_values [n_chunks, nc],
+    cand_indices [n_chunks, nc] CHUNK-LOCAL, counts [n_chunks])`` where
+    ``acc2d = res2d + scale * g2d`` is the new (unzeroed) EF accumulator.
+    Unlike :func:`fused_select_candidates_chunked` the inputs must already
+    be block-aligned (``chunk_pad == ef_padded_chunk(...)``) — there is no
+    ``jnp.pad`` here, which is the point: the pad copy the unfused path
+    pays every step is exactly what fusion removes.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n_chunks, chunk_pad = res2d.shape
+    R, seg, bpc, nc = _chunk_geometry(chunk_pad, density)
+    nseg = R // seg
+    if bpc * R * _LANES != chunk_pad:
+        raise ValueError(
+            f"fused EF path needs block-aligned chunks: chunk_pad="
+            f"{chunk_pad} != {bpc}*{R}*{_LANES}; pad the live EF buffer "
+            f"with ef_padded_chunk first")
+    res = res2d.astype(jnp.float32).reshape(-1, _LANES)
+    g = g2d.astype(jnp.float32).reshape(-1, _LANES)
+    scale2d = jnp.asarray(scale, jnp.float32).reshape(1, 1)
+
+    space = pltpu.VMEM if (_HAS_PLTPU and not interpret) else None
+    smem = pltpu.SMEM if (_HAS_PLTPU and not interpret) else None
+    acc, vals, idxs, counts = pl.pallas_call(
+        functools.partial(_ef_select_kernel, rows=R, seg=seg),
+        grid=(n_chunks, bpc),
+        in_specs=[
+            pl.BlockSpec((R, _LANES), lambda c, i: (c * bpc + i, 0),
+                         memory_space=space),
+            pl.BlockSpec((R, _LANES), lambda c, i: (c * bpc + i, 0),
+                         memory_space=space),
+            pl.BlockSpec((1, 1), lambda c, i: (0, 0), memory_space=smem),
+            pl.BlockSpec((n_chunks, 1), lambda c, i: (0, 0),
+                         memory_space=smem),
+        ],
+        out_specs=(
+            pl.BlockSpec((R, _LANES), lambda c, i: (c * bpc + i, 0),
+                         memory_space=space),
+            pl.BlockSpec((nseg, _LANES), lambda c, i: (c * bpc + i, 0),
+                         memory_space=space),
+            pl.BlockSpec((nseg, _LANES), lambda c, i: (c * bpc + i, 0),
+                         memory_space=space),
+            pl.BlockSpec((n_chunks, 1), lambda c, i: (0, 0),
+                         memory_space=smem),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_chunks * bpc * R, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((n_chunks * bpc * nseg, _LANES),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((n_chunks * bpc * nseg, _LANES),
+                                 jnp.int32),
+            jax.ShapeDtypeStruct((n_chunks, 1), jnp.int32),
+        ),
+        interpret=interpret,
+    )(res, g, scale2d, thresholds.astype(jnp.float32).reshape(n_chunks, 1))
+    return (acc.reshape(n_chunks, chunk_pad),
+            vals.reshape(n_chunks, nc), idxs.reshape(n_chunks, nc),
+            counts[:, 0])
+
+
 def fused_select_candidates(
     acc: jax.Array, threshold: jax.Array, density: float,
     interpret: Optional[bool] = None,
@@ -277,6 +415,14 @@ def fused_select_candidates(
 
 
 _EXACT_CAND_MAX = 1 << 17
+
+# The module docstring's candidate-count claim is DERIVED from the constant
+# (ADVICE r5: the prose said 512k while the code said 128k for a whole
+# round — a placeholder + substitution makes divergence impossible;
+# tests/test_pallas_pack.py asserts the substitution happened).
+if __doc__:  # -OO strips docstrings
+    __doc__ = __doc__.replace("{EXACT_CAND_MAX_K}",
+                              str(_EXACT_CAND_MAX >> 10))
 
 
 def _cand_top_k(vals: jax.Array, k: int):
@@ -470,6 +616,54 @@ def gaussian_fused_compress_batched(
     valid = sent_idx < chunk
     t_new = _controller_update(state, counts, val, valid, k, gain)
     # per-lane cold-bootstrap count fix — see gaussian_fused_compress
+    nsel = jnp.where(state > 0, counts,
+                     jnp.sum(valid.astype(jnp.int32), axis=-1))
+    return CompressResult(comp, residual, nsel), t_new
+
+
+def gaussian_fused_ef_compress_batched(
+    res2d: jax.Array, g2d: jax.Array, scale: jax.Array, k: int,
+    state: jax.Array, rng: Optional[jax.Array] = None, *,
+    density: float = 0.001, sigma_scale: Optional[float] = None,
+    gain: float = 0.18, interpret: Optional[bool] = None,
+) -> Tuple[CompressResult, jax.Array]:
+    """gaussian_fused with the EF accumulate folded INTO the kernel pass —
+    the single-pass form the throughput contract needs at 15-60M params.
+
+    Same warm/cold controller, candidate contract, and EF bookkeeping as
+    ``gaussian_fused_compress_batched``; the difference is purely in HBM
+    traffic: the caller hands the carried residual and the raw (scaled-in-
+    kernel) gradient as pre-padded ``[n_chunks, chunk_pad]`` views and the
+    kernel performs ``acc = res + scale*g`` in the same pass that emits
+    candidates. The returned ``CompressResult.residual`` IS the new padded
+    EF buffer (acc with the k sent entries zeroed) — no pad stripping:
+    the pad region carries zeros in, stays unselected (thresholds >= 0,
+    strict ``>`` mask), and carries zeros out.
+
+    ``sigma_scale`` is accepted for registry-signature parity and unused:
+    the fused path never computes a Gaussian estimate (the cold bootstrap
+    adopts the k-th candidate magnitude instead).
+    """
+    del rng, sigma_scale  # signature parity with the unfused batched form
+    n_chunks, chunk_pad = res2d.shape
+    if ef_padded_chunk(chunk_pad, k, density=density) != chunk_pad:
+        # unlike gaussian_fused_compress_batched there is no silent warm-XLA
+        # fallback here: reaching this path with unpadded chunks means the
+        # caller's build-time eligibility gate is broken — fail loud
+        raise ValueError(
+            f"fused EF path needs pre-padded block-aligned chunks with "
+            f"k <= capacity: got chunk={chunk_pad}, k={k}, "
+            f"density={density} (ef_padded_chunk -> "
+            f"{ef_padded_chunk(chunk_pad, k, density=density)})")
+    acc, vals, idxs, counts = fused_ef_select_candidates_chunked(
+        res2d, g2d, scale, state, density, interpret)
+    sent_idx, val = jax.vmap(
+        lambda vc, ic: _select_candidates_topk(vc, ic, k, chunk_pad)
+    )(vals, idxs)
+    val = val.astype(acc.dtype)
+    comp, residual = jax.vmap(finish_pack)(acc, sent_idx, val)
+    valid = sent_idx < chunk_pad
+    t_new = _controller_update(state, counts, val, valid, k, gain)
     nsel = jnp.where(state > 0, counts,
                      jnp.sum(valid.astype(jnp.int32), axis=-1))
     return CompressResult(comp, residual, nsel), t_new

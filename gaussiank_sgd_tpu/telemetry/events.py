@@ -110,11 +110,19 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
                   "compressor": STRING},
         optional={"ratio_min": NUMBER, "ratio_max": NUMBER,
                   "mfu_dense": NUMBER, "mfu_sparse": NUMBER,
-                  "ex_per_s_chip": NUMBER},
+                  "ex_per_s_chip": NUMBER,
+                  # measurement-protocol + roofline-gate fields (ISSUE 4):
+                  # how many paired rounds back the median, and the
+                  # achieved compression overhead against the per-config
+                  # HBM floor (analysis/roofline.py artifact)
+                  "rounds": NUMBER, "overhead_ms": NUMBER,
+                  "roofline_floor_ms": NUMBER,
+                  "overhead_vs_floor": NUMBER},
     ),
     "bench_summary": EventSchema(
         required={"metric": STRING, "value": NUMBER,
                   "worst_config": STRING},
+        optional={"smoke": NUMBER},     # bool passes NUMBER (see above)
     ),
 }
 

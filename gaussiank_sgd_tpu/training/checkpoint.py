@@ -54,7 +54,8 @@ def _dp_width(state: TrainState) -> Optional[int]:
 
 def save_checkpoint(ckpt_dir: str, state: TrainState,
                     num_workers: Optional[int] = None,
-                    overwrite: bool = False) -> str:
+                    overwrite: bool = False,
+                    unpadded_numel: Optional[int] = None) -> str:
     """Write a checkpoint for the current step; returns its path.
 
     The live ``ef_residual`` is flat ``[P*N]`` (layout, see TrainState
@@ -67,6 +68,15 @@ def save_checkpoint(ckpt_dir: str, state: TrainState,
     restore. The reshape is a jitted shard-local view (dim-0 contiguous
     blocks stay put), so orbax still saves a sharded array — no host
     gather (which would also break non-fully-addressable DCN meshes).
+
+    ``unpadded_numel``: the model's true param count N when the live
+    buffer carries the fused-EF-kernel block padding (per-worker rows of
+    ``DPTrainStep.ef_numel > N``; ops/pallas_pack.py padded-EF contract).
+    The pad region is provably zero (never selected, never written), so
+    stripping it here loses nothing and the ON-DISK FORMAT STAYS [P, N] —
+    checkpoints from padded and unpadded runs are interchangeable.
+    ``restore_checkpoint(padded_numel=...)`` re-adds the zeros on the way
+    back in. No-op when the live rows are already N.
 
     Idempotent per step by default: a SEALED checkpoint that already
     exists for this step is left in place (covers epoch-boundary +
@@ -97,14 +107,23 @@ def save_checkpoint(ckpt_dir: str, state: TrainState,
         raise ValueError(
             f"ef_residual size {state.ef_residual.size} is not divisible "
             f"by num_workers={p}")
+    n_row = state.ef_residual.size // p
+    n_keep = n_row if unpadded_numel is None else int(unpadded_numel)
+    if not 0 < n_keep <= n_row:
+        raise ValueError(
+            f"unpadded_numel={unpadded_numel} outside (0, {n_row}] — the "
+            f"live per-worker EF row is {n_row}")
     sh = getattr(state.ef_residual, "sharding", None)
     mesh = getattr(sh, "mesh", None)
+    # the [:, :n_keep] slice strips the (all-zero) fused-EF block pad;
+    # identity when n_keep == n_row. Shard-local either way: each worker's
+    # row is one dim-0 shard and the slice acts on dim 1.
     if mesh is not None and getattr(mesh, "size", 0):
         dp2d = NamedSharding(mesh, P(tuple(mesh.axis_names)))
-        ef = jax.jit(lambda x: x.reshape(p, -1),
+        ef = jax.jit(lambda x: x.reshape(p, -1)[:, :n_keep],
                      out_shardings=dp2d)(state.ef_residual)
     else:
-        ef = state.ef_residual.reshape(p, -1)
+        ef = state.ef_residual.reshape(p, -1)[:, :n_keep]
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, state._replace(ef_residual=ef))
     ckptr.wait_until_finished()
@@ -196,8 +215,17 @@ def gc_checkpoints(ckpt_dir: str, keep_last: int) -> List[str]:
 
 
 def restore_checkpoint(path: str, target: TrainState,
-                       mesh: Optional[Mesh] = None) -> TrainState:
+                       mesh: Optional[Mesh] = None,
+                       padded_numel: Optional[int] = None) -> TrainState:
     """Restore into the structure of ``target`` with live mesh shardings.
+
+    ``padded_numel``: the live per-worker EF row size when the target run
+    uses the fused-EF kernel's pre-padded buffer (``DPTrainStep.ef_numel``
+    — pass it whenever it differs from the model's param count). The disk
+    format is always the unpadded ``[P, N]``; the pad zeros are re-added
+    shard-locally after restore. Without it, the row size is derived from
+    ``mesh.size`` (exact for both padded and unpadded targets) or assumed
+    unpadded on meshless restores.
 
     With ``mesh`` given, every leaf restores replicated over the mesh EXCEPT
     ``ef_residual``, which restores sharded over the dp axes (its leading
@@ -230,13 +258,25 @@ def restore_checkpoint(path: str, target: TrainState,
     old_p = int(meta["ef_residual"].shape[0])
     ef_dtype = target.ef_residual.dtype
     n_flat = int(meta["ef_residual"].shape[1])
-    new_p = int(target.ef_residual.size) // n_flat
-    if new_p * n_flat != target.ef_residual.size or new_p < 1:
+    # live per-worker row size: explicit (fused-EF padded runs) > derived
+    # from the mesh width > the checkpoint's own N (meshless, unpadded)
+    if padded_numel is not None:
+        n_row = int(padded_numel)
+    elif mesh is not None and int(mesh.size) >= 1 \
+            and target.ef_residual.size % int(mesh.size) == 0:
+        n_row = int(target.ef_residual.size) // int(mesh.size)
+    else:
+        n_row = n_flat
+    pad = n_row - n_flat
+    new_p = int(target.ef_residual.size) // n_row if n_row else 0
+    if pad < 0 or new_p < 1 or new_p * n_row != target.ef_residual.size:
         # user-facing artifact validation: a bare assert would vanish
         # under -O and silently mis-redistribute mass (code-review r4)
         raise ValueError(
-            f"checkpoint param count {n_flat} does not divide the live "
-            f"ef_residual ({target.ef_residual.size}) — different model?")
+            f"checkpoint param count {n_flat} does not fit the live "
+            f"ef_residual ({target.ef_residual.size}, per-worker row "
+            f"{n_row}) — different model, or pass padded_numel= for a "
+            f"fused-EF padded run?")
     carry_leaves = jax.tree_util.tree_leaves(target.carry)
 
     # --- optimizer-format compatibility (r5) -------------------------------
@@ -366,21 +406,28 @@ def restore_checkpoint(path: str, target: TrainState,
         restored = restored._replace(
             opt_state=_convert_opt(restored.opt_state))
     if old_p == new_p:
-        # [P, N] disk layout -> live flat [P*N]; with a mesh the reshape
-        # is shard-local (dim-0 contiguous blocks stay put)
+        # [P, N] disk layout -> live flat [P*n_row]; the fused-EF pad (if
+        # any) is re-added as trailing zeros per row — both the pad and the
+        # reshape are shard-local with a mesh (dim-0 contiguous blocks
+        # stay put, dim 1 is worker-private)
         if mesh is not None:
             dp_flat = NamedSharding(mesh, P(tuple(mesh.axis_names)))
-            ef = jax.jit(lambda x: x.reshape(-1),
-                         out_shardings=dp_flat)(restored.ef_residual)
+            ef = jax.jit(
+                lambda x: jnp.pad(x, ((0, 0), (0, pad))).reshape(-1),
+                out_shardings=dp_flat)(restored.ef_residual)
         else:
-            ef = restored.ef_residual.reshape(-1)
+            ef = jnp.pad(restored.ef_residual, ((0, 0), (0, pad))
+                         ).reshape(-1)
         restored = restored._replace(ef_residual=ef)
     if old_p != new_p:
         # mass-preserving redistribution: every new row = total/new_p,
-        # flattened to the live [new_p * N] layout
+        # padded (fused-EF runs) and flattened to the live [new_p * n_row]
+        # layout — the redistribution itself happens in the UNPADDED space,
+        # so elastic behavior is identical to an unpadded run
         total = jnp.sum(restored.ef_residual, axis=0)
-        ef = jnp.tile((total / new_p)[None, :],
-                      (new_p, 1)).astype(ef_dtype).reshape(-1)
+        rows = jnp.tile((total / new_p)[None, :],
+                        (new_p, 1)).astype(ef_dtype)
+        ef = jnp.pad(rows, ((0, 0), (0, pad))).reshape(-1)
         # the recurrent carry restarts from zeros: its rows are batch rows
         # of the OLD worker geometry and cannot be remapped; warm-up costs
         # a few windows, convergence state (params/opt/EF) is preserved
@@ -414,7 +461,8 @@ def restore_checkpoint(path: str, target: TrainState,
 def restore_latest_good(ckpt_dir: str, target: TrainState,
                         mesh: Optional[Mesh] = None,
                         on_skip=None,
-                        before_step: Optional[int] = None
+                        before_step: Optional[int] = None,
+                        padded_numel: Optional[int] = None
                         ) -> Tuple[TrainState, str]:
     """Restore the newest checkpoint that actually restores.
 
@@ -428,7 +476,8 @@ def restore_latest_good(ckpt_dir: str, target: TrainState,
     already holds the diverged state) is never the rollback target.
     Returns ``(state, path)``; raises ``FileNotFoundError`` when no
     eligible sealed checkpoint exists and ``RuntimeError`` when every
-    candidate failed.
+    candidate failed. ``padded_numel`` forwards to ``restore_checkpoint``
+    (fused-EF padded runs).
 
     The broad ``except Exception`` is deliberate: corruption surfaces as
     whatever orbax/zarr/json error the damaged byte happened to hit, and
@@ -450,7 +499,8 @@ def restore_latest_good(ckpt_dir: str, target: TrainState,
     causes = []
     for _step, path in reversed(ckpts):
         try:
-            return restore_checkpoint(path, target, mesh), path
+            return restore_checkpoint(path, target, mesh,
+                                      padded_numel=padded_numel), path
         except Exception as e:  # noqa: BLE001 — see docstring
             causes.append(f"{os.path.basename(path)}: {type(e).__name__}: "
                           f"{e}")

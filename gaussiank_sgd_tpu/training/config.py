@@ -64,6 +64,10 @@ class TrainConfig:
     fold_lr: bool = False                   # EF on lr-scaled grads (§2.3 note)
     exchange: str = "allgather"             # sparse exchange: 'allgather'
                                             # (C2 path) | 'gtopk' (C3 tree)
+    decorrelate_comp_rng: bool = False      # per-worker compressor RNG (the
+                                            # randomkec shared-vs-decorrelated
+                                            # seed ablation, VERDICT r5 #6;
+                                            # analysis/randomkec_decorrelated)
 
     # numerics
     compute_dtype: str = "bfloat16"         # MXU-native compute
@@ -199,6 +203,12 @@ def add_args(p: argparse.ArgumentParser, suppress_defaults: bool = False) -> Non
                    default=d.exchange,
                    help="sparse exchange: allgather (reference C2) or the "
                         "gTop-k ppermute butterfly (reference C3)")
+    p.add_argument("--decorrelate-comp-rng", dest="decorrelate_comp_rng",
+                   action=argparse.BooleanOptionalAction,
+                   default=d.decorrelate_comp_rng,
+                   help="fold the worker index into the compressor RNG "
+                        "(randomkec seed ablation; see "
+                        "analysis/randomkec_decorrelated.py)")
     p.add_argument("--compress-warmup-steps", dest="compress_warmup_steps",
                    type=int, default=d.compress_warmup_steps)
     p.add_argument("--fold-lr", dest="fold_lr",

@@ -234,14 +234,16 @@ class Trainer:
         if cfg.resume:
             path = None
             if os.path.basename(cfg.resume).startswith("step_"):
-                self.state = restore_checkpoint(cfg.resume, self.state,
-                                                self.mesh)
+                self.state = restore_checkpoint(
+                    cfg.resume, self.state, self.mesh,
+                    padded_numel=self.ts.ef_numel)
                 path = cfg.resume
             else:
                 try:
                     self.state, path = restore_latest_good(
                         cfg.resume, self.state, self.mesh,
-                        on_skip=self._log_restore_skip)
+                        on_skip=self._log_restore_skip,
+                        padded_numel=self.ts.ef_numel)
                 except FileNotFoundError:
                     # nothing committed yet (fresh run dir) — start cold,
                     # same as the pre-resilience behavior
@@ -322,6 +324,7 @@ class Trainer:
             sp_axis="sp" if self.sp else None,
             flat_opt=flat_opt,
             guard_nonfinite=cfg.nonfinite_guard,
+            decorrelate_comp_rng=cfg.decorrelate_comp_rng,
         )
         # drop caches keyed on the replaced programs (phase-timing probes,
         # first-dispatch bookkeeping)
@@ -371,8 +374,11 @@ class Trainer:
         a backed-off LR) — silently keeping the stale state would poison a
         later resume/rollback."""
         step = self.step
+        # unpadded_numel strips the fused-EF block pad (identity on
+        # unpadded runs) so the on-disk format stays [P, total_numel]
         path = save_checkpoint(self.ckpt_dir, self._state,
-                               overwrite=step not in self._saved_steps)
+                               overwrite=step not in self._saved_steps,
+                               unpadded_numel=self.plan.total_numel)
         self._saved_steps.add(step)
         self.bus.publish({"event": "checkpoint", "step": step, "path": path})
         if self.cfg.keep_checkpoints:
@@ -404,7 +410,8 @@ class Trainer:
                 state, path = restore_latest_good(
                     self.ckpt_dir, self._state, self.mesh,
                     on_skip=self._log_restore_skip,
-                    before_step=anomaly_step)
+                    before_step=anomaly_step,
+                    padded_numel=self.ts.ef_numel)
             except FileNotFoundError:
                 if anomaly_step is None:
                     raise
@@ -418,7 +425,8 @@ class Trainer:
                     anomaly_step)
                 state, path = restore_latest_good(
                     self.ckpt_dir, self._state, self.mesh,
-                    on_skip=self._log_restore_skip)
+                    on_skip=self._log_restore_skip,
+                    padded_numel=self.ts.ef_numel)
         except (FileNotFoundError, RuntimeError) as e:
             raise RuntimeError(
                 f"rollback ({reason}) has no restorable checkpoint under "

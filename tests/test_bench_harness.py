@@ -131,3 +131,30 @@ def test_microbatch_divisibility_asserts():
         _microbatch_grads(loss_fn, {"w": jnp.ones(())}, {},
                           (jnp.ones((10, 2)), jnp.ones((10,))),
                           None, num_microbatches=3)
+
+
+def test_noise_floored_delta_never_negative():
+    """Phase deltas are durations: below-noise or sign-flipped paired
+    medians report None ('< noise'), never a negative ms figure
+    (VERDICT r5 weak #5)."""
+    from gaussiank_sgd_tpu.benchlib import (noise_floored_delta_ms,
+                                            paired_delta_ms)
+
+    # clear positive delta, low jitter -> reported, matches paired median
+    rounds = {"a": [0.012, 0.0121, 0.0119], "b": [0.010, 0.0101, 0.0099]}
+    d = noise_floored_delta_ms(rounds, "a", "b")
+    assert d == paired_delta_ms(rounds, "a", "b") and d > 0
+
+    # negative paired median (probe slower than the full program by
+    # drift) -> None, while the raw estimator goes negative
+    rounds = {"a": [0.010, 0.0099, 0.0101], "b": [0.011, 0.0111, 0.0109]}
+    assert paired_delta_ms(rounds, "a", "b") < 0
+    assert noise_floored_delta_ms(rounds, "a", "b") is None
+
+    # tiny positive median buried in round-to-round jitter -> None
+    rounds = {"a": [0.0101, 0.0095, 0.0107], "b": [0.0100, 0.0100, 0.0100]}
+    assert noise_floored_delta_ms(rounds, "a", "b") is None
+
+    # mismatched round counts (partial run) -> None, like paired_delta_ms
+    rounds = {"a": [0.012, 0.012], "b": [0.010]}
+    assert noise_floored_delta_ms(rounds, "a", "b") is None

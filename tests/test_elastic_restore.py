@@ -21,7 +21,8 @@ from gaussiank_sgd_tpu.training.checkpoint import (restore_checkpoint,
                                                    save_checkpoint)
 
 
-def _problem(n_dev, batch=16, optimizer=None, flat_opt=None):
+def _problem(n_dev, batch=16, optimizer=None, flat_opt=None,
+             compressor="gaussian", density=0.1):
     import flax.linen as nn
 
     class M(nn.Module):
@@ -40,8 +41,8 @@ def _problem(n_dev, batch=16, optimizer=None, flat_opt=None):
             logits, b[1]).mean(), (mstate, {}))
 
     mesh = data_parallel_mesh(n_dev)
-    comp = get_compressor("gaussian", density=0.1)
-    plan = plan_for_params(v["params"], 0.1)
+    comp = get_compressor(compressor, density=density)
+    plan = plan_for_params(v["params"], density)
     if flat_opt is None and optimizer is None:
         optimizer = optax.sgd(0.1)
     ts = build_dp_train_step(loss_fn, optimizer, comp, plan, mesh,
@@ -241,6 +242,131 @@ def test_legacy_optax_momentum_ravels_into_flat_opt(tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(restored.params),
                     jax.tree_util.tree_leaves(s8.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def _disk_ef_shape(path):
+    import orbax.checkpoint as ocp
+    meta = ocp.StandardCheckpointer().metadata(path)
+    meta = getattr(meta, "item_metadata", meta)
+    return tuple(meta["ef_residual"].shape)
+
+
+def test_padded_ef_roundtrip_bit_identity_and_disk_format(tmp_path):
+    """Fused-EF runs carry a block-padded live EF buffer (ops/pallas_pack
+    padded-EF contract). A save/restore round trip at the same worker
+    count must be BIT-identical on the full padded buffer, and the on-disk
+    format must stay the unpadded [P, N] — interchangeable with
+    checkpoints from unpadded runs."""
+    ts8, s8, b8 = _problem(8, compressor="gaussian_fused", density=0.01)
+    n_total = sum(l.size for l in jax.tree_util.tree_leaves(s8.params))
+    assert ts8.ef_numel > n_total            # fused path active -> padded
+    for _ in range(2):
+        s8, _ = ts8.sparse_step(s8, b8)
+    ef_live = np.asarray(s8.ef_residual)
+    assert np.abs(ef_live).sum() > 0
+    # pad region is all-zero, so stripping it on save loses nothing
+    assert not ef_live.reshape(8, ts8.ef_numel)[:, n_total:].any()
+
+    path = save_checkpoint(str(tmp_path / "ck"), s8,
+                           unpadded_numel=n_total)
+    assert _disk_ef_shape(path) == (8, n_total)   # format unchanged
+
+    ts2, s2, b2 = _problem(8, compressor="gaussian_fused", density=0.01)
+    restored = restore_checkpoint(path, s2, ts2.mesh,
+                                  padded_numel=ts2.ef_numel)
+    np.testing.assert_array_equal(np.asarray(restored.ef_residual),
+                                  ef_live)
+    # mesh-derived row size (no explicit padded_numel) must agree
+    restored2 = restore_checkpoint(path, s2, ts2.mesh)
+    np.testing.assert_array_equal(np.asarray(restored2.ef_residual),
+                                  ef_live)
+    restored, m = ts2.sparse_step(restored, b2)
+    assert np.isfinite(float(m.loss))
+
+
+def test_padded_ef_elastic_worker_change(tmp_path):
+    """Elastic restore (8 -> 4 workers) into a padded fused-EF target:
+    redistribution happens in the UNPADDED space (mass-preserving, same
+    as an unpadded run), then each new row re-pads with zeros."""
+    ts8, s8, b8 = _problem(8, compressor="gaussian_fused", density=0.01)
+    n_total = sum(l.size for l in jax.tree_util.tree_leaves(s8.params))
+    for _ in range(2):
+        s8, _ = ts8.sparse_step(s8, b8)
+    ef_total = np.asarray(s8.ef_residual).reshape(
+        8, ts8.ef_numel)[:, :n_total].sum(axis=0)
+    assert np.abs(ef_total).sum() > 0
+    path = save_checkpoint(str(tmp_path / "ck"), s8,
+                           unpadded_numel=n_total)
+    assert _disk_ef_shape(path) == (8, n_total)
+
+    ts4, s4, b4 = _problem(4, compressor="gaussian_fused", density=0.01)
+    restored = restore_checkpoint(path, s4, ts4.mesh,
+                                  padded_numel=ts4.ef_numel)
+    assert restored.ef_residual.size == 4 * ts4.ef_numel
+    rows = np.asarray(restored.ef_residual).reshape(4, ts4.ef_numel)
+    np.testing.assert_allclose(rows[:, :n_total].sum(axis=0), ef_total,
+                               rtol=1e-5, atol=1e-7)
+    assert not rows[:, n_total:].any()           # pad re-enters as zeros
+    for a, b in zip(jax.tree_util.tree_leaves(s8.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    restored, m = ts4.sparse_step(restored, b4)
+    assert np.isfinite(float(m.loss))
+
+
+def test_legacy_optax_momentum_into_padded_fused_run(tmp_path):
+    """The satellite-2 conversion composed with the padded-EF edge: a
+    checkpoint written by optax.chain(add_decayed_weights,
+    sgd(momentum=0.9)) restores into a flat-opt fused-EF run —
+    opt_state['m'] == ravel_pytree(trace) AND the padded EF rows strip
+    on save / re-pad on restore in the same round trip. (Same
+    compressor both sides: optimizer-format migration is the subject;
+    compressor-state migration is not supported.)"""
+    from jax.flatten_util import ravel_pytree
+
+    from gaussiank_sgd_tpu.parallel.flat_opt import FlatSGDM
+
+    legacy = optax.chain(optax.add_decayed_weights(1e-4),
+                         optax.sgd(0.1, momentum=0.9))
+    ts8, s8, b8 = _problem(8, optimizer=legacy,
+                           compressor="gaussian_fused", density=0.01)
+    for _ in range(3):
+        s8, _ = ts8.sparse_step(s8, b8)
+
+    def find_trace(node):
+        if hasattr(node, "trace"):
+            return node.trace
+        if isinstance(node, (list, tuple)):
+            for v in node:
+                r = find_trace(v)
+                if r is not None:
+                    return r
+        return None
+
+    flat_trace, _ = ravel_pytree(find_trace(s8.opt_state))
+    assert float(jnp.abs(flat_trace).sum()) > 0
+    n_total = flat_trace.size
+    assert ts8.ef_numel > n_total            # legacy run is itself padded
+    ef_old = np.asarray(s8.ef_residual).reshape(
+        8, ts8.ef_numel)[:, :n_total]
+    path = save_checkpoint(str(tmp_path / "ck"), s8,
+                           unpadded_numel=n_total)
+    assert _disk_ef_shape(path) == (8, n_total)
+
+    ts_f, s_f, b_f = _problem(
+        8, compressor="gaussian_fused", density=0.01,
+        flat_opt=FlatSGDM(lr=0.1, momentum=0.9, weight_decay=1e-4))
+    assert ts_f.ef_numel > n_total
+    restored = restore_checkpoint(path, s_f, ts_f.mesh,
+                                  padded_numel=ts_f.ef_numel)
+    assert set(restored.opt_state) == {"m"}
+    np.testing.assert_allclose(np.asarray(restored.opt_state["m"]),
+                               np.asarray(flat_trace), rtol=1e-6, atol=0)
+    rows = np.asarray(restored.ef_residual).reshape(8, ts_f.ef_numel)
+    np.testing.assert_array_equal(rows[:, :n_total], ef_old)
+    assert not rows[:, n_total:].any()
+    restored, m = ts_f.sparse_step(restored, b_f)
+    assert np.isfinite(float(m.loss))
 
 
 def test_flat_opt_checkpoint_into_optax_run_fails_loud(tmp_path):

@@ -344,3 +344,109 @@ def test_registry_entry_and_train_step():
         losses.append(float(m.loss))
     assert losses[-1] < losses[0]          # actually learns through the kernel
     assert int(state.step) == 6
+
+
+def test_docstring_candidate_count_derived_from_constant():
+    """ADVICE r5: the module prose once said 512k while the code said 128k.
+    The docstring now substitutes {EXACT_CAND_MAX_K} from _EXACT_CAND_MAX —
+    assert the substitution ran and agrees with the constant."""
+    from gaussiank_sgd_tpu.ops import pallas_pack as pp
+
+    assert "{EXACT_CAND_MAX_K}" not in pp.__doc__
+    assert f"{pp._EXACT_CAND_MAX >> 10}k candidates" in pp.__doc__
+
+
+def test_ef_padded_chunk_geometry():
+    from gaussiank_sgd_tpu.ops.pallas_pack import (_chunk_geometry,
+                                                   ef_padded_chunk)
+
+    # block-aligned suffix pad at supported density
+    cp = ef_padded_chunk(100_000, 100, density=0.001)
+    R, _, bpc, _ = _chunk_geometry(100_000, 0.001)
+    assert cp == bpc * R * _LANES and cp >= 100_000
+    # an already-aligned uniform chunk maps to itself (multi-chunk
+    # eligibility: offsets unchanged)
+    assert ef_padded_chunk(32_768, 32, density=0.001) == 32_768
+    # unsupported density / over-capacity k -> None (unfused fallback)
+    assert ef_padded_chunk(100_000, 100, density=0.5) is None
+    _, _, _, nc = _chunk_geometry(100_000, 0.001)
+    assert ef_padded_chunk(100_000, nc + 1, density=0.001) is None
+
+
+def test_fused_ef_matches_unfused_on_same_acc():
+    """The EF+select kernel must select the same set, produce the same
+    controller update, and the same residual (to accumulate rounding — the
+    kernel may fuse res + scale*g into an FMA) as the unfused batched form
+    run on a precomputed acc."""
+    from gaussiank_sgd_tpu.ops.pallas_pack import (
+        ef_padded_chunk, gaussian_fused_ef_compress_batched)
+
+    rng = np.random.default_rng(29)
+    n, density = 50_000, 0.01
+    k = max(1, int(np.ceil(density * n)))
+    cp = ef_padded_chunk(n, k, density=density)
+    res = np.zeros((1, cp), np.float32)
+    res[0, :n] = rng.normal(0, 0.1, n).astype(np.float32)
+    g = np.zeros((1, cp), np.float32)
+    g[0, :n] = rng.normal(0, 1, n).astype(np.float32)
+    state = jnp.asarray([0.5], jnp.float32)
+    scale = jnp.float32(0.3)
+
+    r, t_new = gaussian_fused_ef_compress_batched(
+        jnp.asarray(res), jnp.asarray(g), scale, k, state, density=density)
+    acc = jnp.asarray(res) + scale * jnp.asarray(g)
+    r_ref, t_ref = gaussian_fused_compress_batched(acc, k, state,
+                                                   density=density)
+    fi = np.asarray(r.compressed.indices[0])
+    fv = np.asarray(r.compressed.values[0])
+    ri = np.asarray(r_ref.compressed.indices[0])
+    rv = np.asarray(r_ref.compressed.values[0])
+    assert set(fi[fv != 0]) == set(ri[rv != 0])
+    np.testing.assert_allclose(np.asarray(t_new), np.asarray(t_ref),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(r.residual),
+                               np.asarray(r_ref.residual),
+                               rtol=0, atol=1.5e-7)
+    assert int(r.num_selected[0]) == int(r_ref.num_selected[0])
+
+
+def test_fused_ef_exact_bookkeeping_and_inert_pad():
+    """EF exactness against the kernel's own accumulator: residual +
+    scatter(sent) == res + scale*g, and the pad region stays exactly zero
+    (thresholds >= 0, strict > mask) — the invariant the padded live
+    buffer contract rests on."""
+    from gaussiank_sgd_tpu.ops.pallas_pack import (
+        ef_padded_chunk, gaussian_fused_ef_compress_batched)
+
+    rng = np.random.default_rng(31)
+    n, density = 70_001, 0.01                       # ragged size
+    k = max(1, int(np.ceil(density * n)))
+    cp = ef_padded_chunk(n, k, density=density)
+    res = np.zeros((1, cp), np.float32)
+    res[0, :n] = rng.normal(0, 0.2, n).astype(np.float32)
+    g = np.zeros((1, cp), np.float32)
+    g[0, :n] = rng.normal(0, 1, n).astype(np.float32)
+    state = jnp.asarray([0.8], jnp.float32)
+    r, _t = gaussian_fused_ef_compress_batched(
+        jnp.asarray(res), jnp.asarray(g), jnp.float32(1.0), k, state,
+        density=density)
+    rec = np.asarray(r.residual[0]).copy()
+    idx = np.asarray(r.compressed.indices[0])
+    val = np.asarray(r.compressed.values[0])
+    ok = idx < cp                                   # sentinel slots invalid
+    np.add.at(rec, idx[ok], val[ok])
+    np.testing.assert_allclose(rec, res[0] + g[0], rtol=1e-6, atol=1e-6)
+    # inert pad: nothing selected there, residual pad exactly zero
+    assert not np.asarray(r.residual[0, n:]).any()
+    assert (idx[ok] < n).all()
+
+
+def test_fused_ef_rejects_unaligned_chunks():
+    from gaussiank_sgd_tpu.ops.pallas_pack import (
+        gaussian_fused_ef_compress_batched)
+
+    x = jnp.zeros((1, 5000), jnp.float32)           # not block-aligned
+    with pytest.raises(ValueError, match="pre-padded"):
+        gaussian_fused_ef_compress_batched(
+            x, x, jnp.float32(1.0), 50, jnp.zeros((1,), jnp.float32),
+            density=0.01)

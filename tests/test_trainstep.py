@@ -297,3 +297,107 @@ def test_flat_opt_matches_optax_gtopk():
         np.testing.assert_allclose(np.asarray(s_flat.params[kname]),
                                    np.asarray(s_ref.params[kname]),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_fused_ef_path_active_and_matches_unfused():
+    """gaussian_fused + allgather + single bucket must take the fused
+    EF+select path (padded ef_numel) and track the unfused program's
+    trajectory to accumulate-rounding tolerance (the kernel may FMA the
+    res + scale*g accumulate)."""
+    params, loss_fn, make_batch = make_problem()
+    mesh = data_parallel_mesh()
+    spec = get_compressor("gaussian_fused", density=0.01)
+    plan = plan_for_params(params, 0.01)
+    n_total = plan.total_numel
+
+    ts_f = build_dp_train_step(loss_fn, optax.sgd(0.05), spec, plan, mesh)
+    assert ts_f.ef_numel > n_total            # padded: fused path active
+    # same compressor with the fused form masked off -> unfused reference
+    spec_u = spec._replace(fused_ef_fn=None, ef_pad=None)
+    ts_u = build_dp_train_step(loss_fn, optax.sgd(0.05), spec_u, plan, mesh)
+    assert ts_u.ef_numel == n_total
+
+    batch = shard_batch(mesh, make_batch(64))
+    sf = ts_f.init_state(params, jax.random.PRNGKey(42))
+    su = ts_u.init_state(params, jax.random.PRNGKey(42))
+    for _ in range(8):
+        sf, mf = ts_f.sparse_step(sf, batch)
+        su, mu = ts_u.sparse_step(su, batch)
+    pf, _ = ravel_pytree(sf.params)
+    pu, _ = ravel_pytree(su.params)
+    np.testing.assert_allclose(np.asarray(pf), np.asarray(pu),
+                               rtol=2e-5, atol=2e-6)
+    assert float(mf.num_selected) == pytest.approx(
+        float(mu.num_selected), rel=0.1)
+    # pad region of every worker's padded row stays exactly zero
+    ef = np.asarray(sf.ef_residual).reshape(mesh.size, ts_f.ef_numel)
+    assert not ef[:, n_total:].any()
+    # and the unpadded prefix matches the unfused residual to rounding
+    ef_u = np.asarray(su.ef_residual).reshape(mesh.size, n_total)
+    np.testing.assert_allclose(ef[:, :n_total], ef_u, rtol=2e-5, atol=2e-6)
+
+
+def test_fused_ef_guard_skip_bit_identity():
+    """A non-finite batch through the FUSED path must commit the old
+    params/opt/EF bit-identically (padded buffer included) while step/rng
+    advance — the guard contract is layout-independent."""
+    params, loss_fn, make_batch = make_problem()
+    mesh = data_parallel_mesh()
+    spec = get_compressor("gaussian_fused", density=0.01)
+    plan = plan_for_params(params, 0.01)
+    ts = build_dp_train_step(loss_fn, optax.sgd(0.05), spec, plan, mesh)
+    state = ts.init_state(params, jax.random.PRNGKey(42))
+    batch = shard_batch(mesh, make_batch(64))
+    for _ in range(3):                   # build up a nonzero residual
+        state, _m = ts.sparse_step(state, batch)
+    before_params = np.asarray(ravel_pytree(state.params)[0])
+    before_ef = np.asarray(state.ef_residual)
+    before_step = int(state.step)
+    x, y = make_batch(64)
+    bad = shard_batch(mesh, (x.at[0, 0].set(jnp.nan), y))
+    state, m = ts.sparse_step(state, bad)
+    assert float(m.skipped) == 1.0 and float(m.nonfinite) > 0
+    assert int(state.step) == before_step + 1
+    assert np.array_equal(np.asarray(ravel_pytree(state.params)[0]),
+                          before_params)
+    assert np.array_equal(np.asarray(state.ef_residual), before_ef)
+
+
+def test_gtopk_and_bf16_fall_back_to_unfused():
+    """Build-time eligibility: gtopk (needs the materialized accumulator)
+    and non-f32 grad dtypes must keep the unfused path."""
+    params, loss_fn, make_batch = make_problem()
+    mesh = data_parallel_mesh()
+    spec = get_compressor("gaussian_fused", density=0.01)
+    plan = plan_for_params(params, 0.01)
+    ts_g = build_dp_train_step(loss_fn, optax.sgd(0.05), spec, plan, mesh,
+                               exchange="gtopk")
+    assert ts_g.ef_numel == plan.total_numel
+    ts_b = build_dp_train_step(loss_fn, optax.sgd(0.05), spec, plan, mesh,
+                               grad_dtype=jnp.bfloat16)
+    assert ts_b.ef_numel == plan.total_numel
+
+
+def test_decorrelate_comp_rng_spreads_random_indices():
+    """Satellite (VERDICT r5 weak #6): with the shared compressor seed all
+    8 workers draw the SAME randomkec indices, so one step touches ~k
+    coordinates; decorrelated seeds touch ~8x more. The flag must change
+    exactly that and nothing else about the program."""
+    params, loss_fn, make_batch = make_problem()
+    mesh = data_parallel_mesh()
+    spec = get_compressor("randomkec", density=0.05)
+    plan = plan_for_params(params, 0.05)
+
+    def run(decorrelate):
+        ts = build_dp_train_step(loss_fn, optax.sgd(0.5), spec, plan, mesh,
+                                 decorrelate_comp_rng=decorrelate)
+        state = ts.init_state(params, jax.random.PRNGKey(42))
+        batch = shard_batch(mesh, make_batch(64))
+        new_state, _m = ts.sparse_step(state, batch)
+        p0, _ = ravel_pytree(params)
+        p1, _ = ravel_pytree(new_state.params)
+        return int(np.sum(np.asarray(p0) != np.asarray(p1)))
+
+    shared = run(False)
+    spread = run(True)
+    assert spread > 2 * shared
