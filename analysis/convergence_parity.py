@@ -8,7 +8,11 @@ same model with the same seeds under several exchange/compressor arms on the
 
 Arms: dense psum | gaussian@density (allgather) | topk@density (allgather) |
 gaussian@density (gTop-k butterfly, SURVEY.md §2.3) — i.e. both the C2 and
-C3 communication paths of the reference.
+C3 communication paths of the reference. An arm spec may carry a
+``:wire=off`` suffix (e.g. ``gaussian_fused,gaussian_fused:wire=off``) to
+pin the legacy i32+f32 exchange — the packed-wire convergence control of
+ISSUE 5 (parallel/wire.py): same plan, same selection, only the wire
+differs.
 
 Artifacts (analysis/artifacts/):
   convergence_parity.json — summary table (+ bytes/step per arm)
@@ -61,6 +65,11 @@ def run_arm(name, steps, density, outdir, **overrides):
         "arm": name,
         "compressor": cfg["compressor"],      # provenance: what actually ran
         "exchange": cfg.get("exchange", "allgather"),
+        # wire format the sparse bytes traveled in (BASELINE.md protocol:
+        # a bytes claim never goes out without its format name)
+        "wire_format": next(
+            (r["wire_format"] for r in reversed(tr)
+             if r.get("wire_format") is not None), None),
         "final_loss": tr[-1]["loss"],
         "val_loss": res["val_loss"],
         "top1": res.get("top1"),
@@ -102,8 +111,16 @@ def main(argv=None):
                    help="global grad-norm clip (the reference's LSTM "
                         "setting, SURVEY.md §3.2)")
     p.add_argument("--arms", default=DEFAULT_ARMS,
-                   help="comma list of compressor[@exchange]; 'none' = the "
-                        "dense baseline arm")
+                   help="comma list of compressor[@exchange][:wire=off]; "
+                        "'none' = the dense baseline arm, ':wire=off' pins "
+                        "the legacy i32+f32 exchange format")
+    p.add_argument("--bucket-size", dest="bucket_size", type=int,
+                   default=None)
+    p.add_argument("--bucket-policy", dest="bucket_policy",
+                   choices=("greedy", "uniform"), default="greedy",
+                   help="bucket plan passthrough — 'uniform' with "
+                        "bucket_size <= 65536 makes arms wire-eligible "
+                        "at any model scale")
     p.add_argument("--seeds", type=int, default=1,
                    help="run every arm with seeds 0..N-1 and report "
                         "mean +/- std per arm (error bars, VERDICT r2 "
@@ -146,22 +163,31 @@ def main(argv=None):
                   model_kwargs=args.model_kwargs,
                   dataset_kwargs=dataset_kwargs,
                   clip_norm=args.clip_norm,
+                  bucket_size=args.bucket_size,
+                  bucket_policy=args.bucket_policy,
                   compress_warmup_steps=args.compress_warmup_steps)
     from gaussiank_sgd_tpu.compressors import NAMES as COMP_NAMES
     arms = []
     for spec_str in args.arms.split(","):
-        comp, _, exch = spec_str.strip().partition("@")
+        base, _, opt = spec_str.strip().partition(":")
+        comp, _, exch = base.partition("@")
         if comp not in COMP_NAMES:
             p.error(f"bad arm spec {spec_str!r}: compressor must be one of "
                     f"{COMP_NAMES}")
         if exch and exch not in ("allgather", "gtopk"):
             p.error(f"bad arm spec {spec_str!r}: exchange must be "
                     f"allgather or gtopk")
+        if opt and opt != "wire=off":
+            p.error(f"bad arm spec {spec_str!r}: the only option is "
+                    f":wire=off")
         name = comp if comp != "none" else "dense"
         ov = dict(compressor=comp)
         if exch:
             name += f"_{exch}"
             ov["exchange"] = exch
+        if opt:
+            name += "_wireoff"
+            ov["wire"] = "off"
         arms.append((name, ov))
     results = []          # one aggregated record per arm
     for name, ov in arms:
@@ -203,7 +229,8 @@ def main(argv=None):
                        for k, v in sorted(vars(args).items())
                        if v not in (None, "") and v != {})},
         "arms": [{k: r.get(k) for k in
-                  ("arm", "compressor", "exchange", "final_loss",
+                  ("arm", "compressor", "exchange", "wire_format",
+                   "final_loss",
                    "val_loss", "top1", "perplexity", "cer",
                    "bytes_per_step", "final_loss_agg", "val_loss_agg",
                    "top1_agg", "perplexity_agg", "cer_agg")}

@@ -18,15 +18,21 @@ ONE scale. This script makes the floor a measured, per-config artifact:
        write EF accumulator 4n     (doubles as the new residual)
        write candidates     8nc    (f32 value + i32 ranking key)
        re-read candidates   8nc    (the top-k over the candidate buffer)
-       k-pair traffic      24k     (pack + exchange staging + scatter)
+       k-entry traffic     12k     (pack + exchange staging + scatter,
+                                    3 stages x 4 bytes: the u16+bf16
+                                    packed wire word, parallel/wire.py)
 
-     = 12n + 16nc + 24k bytes. The UNFUSED path pays two more n-sized
+     = 12n + 16nc + 12k bytes. The UNFUSED path pays two more n-sized
      passes (separate EF accumulate read-modify-write amortized: +4n;
-     residual copy-with-holes: read 4n + write 4n) = 24n + 16nc + 24k,
+     residual copy-with-holes: read 4n + write 4n) = 24n + 16nc + 12k,
      which is what the fusion removes. n = model param count (computed
      here via ``jax.eval_shape`` over the real model init — no 57M
      materialization), nc = the Pallas kernel's candidate count
-     (``ops.pallas_pack._chunk_geometry``), k = density * n.
+     (``ops.pallas_pack._chunk_geometry``), k = density * n. The floors
+     price the COMPACT wire format (ISSUE 5): a wire-ineligible config
+     pays 8 bytes/entry (i32+f32) instead — +12k, < 0.2% of the n-sized
+     terms at the contract density 0.001, so pricing every floor at the
+     packed format keeps the gate tight without a per-config fork.
   3. **floor_ms = bytes / measured BW** per config, and — when a bench
      artifact (analysis/artifacts/bench_last.json) is present — the
      achieved overhead (sparse_step_ms - dense_step_ms) against
@@ -114,9 +120,14 @@ def measure_bandwidth_gbps(n: int, n_steps: int = 20, rounds: int = 5):
     return statistics.median(per_round), [round(b, 2) for b in per_round]
 
 
-def floor_bytes(n: int, density: float):
+def floor_bytes(n: int, density: float, wire_bytes_per_entry: int = 4):
     """(fused_bytes, unfused_bytes, nc, k) that must move for one
-    compression phase at n params (byte model in the module docstring)."""
+    compression phase at n params (byte model in the module docstring).
+
+    ``wire_bytes_per_entry``: 4 for the packed u16+bf16 wire word
+    (parallel/wire.py, the default the floors gate against), 8 for the
+    legacy i32+f32 pair — the k-entry traffic is 3 stages (pack +
+    exchange staging + scatter) x that entry size."""
     from gaussiank_sgd_tpu.ops.pallas_pack import (_chunk_geometry,
                                                    supports_density)
     k = max(1, int(n * density))
@@ -124,8 +135,9 @@ def floor_bytes(n: int, density: float):
         _, _, _, nc = _chunk_geometry(n, density)
     else:
         nc = n                       # warm-fallback scans the full buffer
-    fused = 12 * n + 16 * nc + 24 * k
-    unfused = 24 * n + 16 * nc + 24 * k
+    k_term = 3 * wire_bytes_per_entry * k
+    fused = 12 * n + 16 * nc + k_term
+    unfused = 24 * n + 16 * nc + k_term
     return fused, unfused, nc, k
 
 
@@ -135,6 +147,11 @@ def main(argv=None):
                     help="f32 elements in the bandwidth-probe buffer")
     ap.add_argument("--n-steps", type=int, default=20)
     ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--bw-gbps", type=float, default=None,
+                    help="skip the bandwidth probe and price floors at "
+                         "this GB/s (re-derive an artifact from a prior "
+                         "measured bandwidth, e.g. after a byte-model "
+                         "change, without re-measuring)")
     ap.add_argument("--density", type=float, default=0.001)
     ap.add_argument("--configs", nargs="*", default=None,
                     help="subset of config keys (default: all five)")
@@ -144,8 +161,11 @@ def main(argv=None):
 
     import jax
 
-    bw_gbps, bw_rounds = measure_bandwidth_gbps(
-        args.bw_n, n_steps=args.n_steps, rounds=args.rounds)
+    if args.bw_gbps is not None:
+        bw_gbps, bw_rounds = args.bw_gbps, []
+    else:
+        bw_gbps, bw_rounds = measure_bandwidth_gbps(
+            args.bw_n, n_steps=args.n_steps, rounds=args.rounds)
 
     # achieved overhead per config, when a bench artifact from the SAME
     # platform is available — a TPU bench priced against CPU DRAM
@@ -197,15 +217,21 @@ def main(argv=None):
     res = {
         "bandwidth_gbps": round(bw_gbps, 2),
         "bandwidth_rounds_gbps": bw_rounds,
-        "bw_probe": {"n": args.bw_n, "n_steps": args.n_steps,
-                     "rounds": args.rounds,
-                     "bytes_per_step": 8 * args.bw_n,
-                     "method": "loop-carried f32 scale pass (1 read + "
-                               "1 write), jitted fori_loop, scalar fence; "
-                               "median of rounds"},
+        "bw_probe": ({"method": "--bw-gbps override: floors re-priced "
+                                "from a previously measured bandwidth "
+                                "(no fresh probe this run)"}
+                     if args.bw_gbps is not None else
+                     {"n": args.bw_n, "n_steps": args.n_steps,
+                      "rounds": args.rounds,
+                      "bytes_per_step": 8 * args.bw_n,
+                      "method": "loop-carried f32 scale pass (1 read + "
+                                "1 write), jitted fori_loop, scalar fence; "
+                                "median of rounds"}),
         "density": args.density,
-        "byte_model": "fused: 12n + 16nc + 24k; unfused: 24n + 16nc + "
-                      "24k (see module docstring)",
+        "byte_model": "fused: 12n + 16nc + 12k; unfused: 24n + 16nc + "
+                      "12k (u16bf16 packed wire, 4 bytes/entry x 3 "
+                      "stages — see module docstring)",
+        "wire_format": "u16bf16",
         "configs": configs,
         "bench_platform": bench_platform,
         "platform": jax.devices()[0].platform,

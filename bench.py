@@ -55,10 +55,14 @@ CONFIGS = (
 
 # --smoke: one tiny config, CI-sized (seconds, not minutes, on CPU) — the
 # point is exercising the full harness + telemetry emission path, not a
-# meaningful throughput number
+# meaningful throughput number. Smoke runs on a uniform 8192-element bucket
+# plan: small enough to pass the wire gate (chunk <= 65536, parallel/
+# wire.py) AND block-aligned for the fused EF+select kernel, so CI
+# exercises — and asserts on — the packed u16+bf16 exchange end to end.
 SMOKE_CONFIGS = (
     ("mnistnet", "mnistnet", "mnist", 8, 2, 2),
 )
+SMOKE_BUCKETS = {"bucket_policy": "uniform", "bucket_size": 8192}
 
 
 def _ratios(times, name):
@@ -135,7 +139,8 @@ def main(argv: Optional[List[str]] = None):
         # bound driver wall-clock
         comps = SWEEP if key == "resnet20" else (FIXED,)
         times = bench_model(model, dataset, batch, density, comps,
-                            n_steps=n_steps, rounds=rounds)
+                            n_steps=n_steps, rounds=rounds,
+                            **(SMOKE_BUCKETS if args.smoke else {}))
         flops = times.get("_dense_step_flops")
         peak = times.get("_peak_flops")
         md = mfu(flops, times["dense"], peak)
@@ -154,6 +159,11 @@ def main(argv: Optional[List[str]] = None):
         # config under 0.90)
         cell["overhead_ms"] = round(cell["sparse_step_ms"]
                                     - cell["dense_step_ms"], 3)
+        # wire accounting rides next to every bytes claim (parallel/wire.py
+        # protocol: a bytes number never travels without its format name)
+        ex = times.get("_exchange", {}).get(FIXED, {})
+        cell["wire_format"] = ex.get("wire_format")
+        cell["bytes_sent"] = ex.get("bytes_sent")
         if key in floors:
             cell["roofline_floor_ms"] = floors[key]
             cell["overhead_vs_floor"] = (
@@ -182,10 +192,26 @@ def main(argv: Optional[List[str]] = None):
                  mfu_sparse=cell["mfu_sparse"],
                  overhead_ms=cell["overhead_ms"],
                  roofline_floor_ms=cell.get("roofline_floor_ms"),
-                 overhead_vs_floor=cell.get("overhead_vs_floor"))
+                 overhead_vs_floor=cell.get("overhead_vs_floor"),
+                 wire_format=cell["wire_format"],
+                 bytes_sent=cell["bytes_sent"])
         print(f"# {key}: median {cell['ratio_median']} "
               f"min {cell['ratio_min']} mfu_dense {cell['mfu_dense']}",
               flush=True)
+        if args.smoke:
+            # CI acceptance (ISSUE 5): the smoke plan is wire-eligible by
+            # construction, so the measured payload must be <= 0.55x the
+            # fp32+i32 format at identical k (8 bytes/entry; the fixed
+            # selector packs exactly total_k entries). ValueError, not
+            # assert: the gate must fire under -O too (repo convention).
+            fp32_bytes = ex["total_k"] * 8
+            if (ex.get("wire_format") != "u16bf16"
+                    or ex["bytes_sent"] > 0.55 * fp32_bytes):
+                raise ValueError(
+                    f"smoke wire gate failed: wire_format="
+                    f"{ex.get('wire_format')!r}, bytes_sent="
+                    f"{ex.get('bytes_sent')} vs fp32+i32 {fp32_bytes} "
+                    f"(need u16bf16 and <= 0.55x)")
 
     # The contract is "EVERY config >= 0.90" (BASELINE.json metric), so the
     # reportable scalar is the MIN over config medians — the binding number

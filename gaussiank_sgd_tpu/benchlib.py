@@ -210,7 +210,11 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
     program on one model. Timing keys: 'dense' + compressor names.
     Underscore-prefixed keys are metadata, NOT timings: ``_rounds``
     (per-round samples, dict of lists), ``_dense_step_flops`` and
-    ``_peak_flops`` (MFU inputs) — consumers iterating the dict must
+    ``_peak_flops`` (MFU inputs), ``_exchange`` (per-compressor wire
+    accounting: the build's wire format name, its measured per-step
+    ``bytes_sent`` drained from the warm run's StepMetrics, and the
+    plan's total_k — the bytes are the concrete exchanged buffers'
+    count, parallel/wire.py) — consumers iterating the dict must
     filter them.
 
     ``bucket_policy``/``bucket_size``: the selection-unit plan (SURVEY.md
@@ -241,6 +245,7 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
 
     probes = ablation_specs()
     programs = {}
+    exchange_meta: Dict[str, dict] = {}
     dense_ts = dense_mk = None
     for name in compressors:
         comp = probes.get(name) or get_compressor(name, density=density)
@@ -260,10 +265,16 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
             programs["dense"] = (ts.make_multi_step("dense", n_steps), mk)
             dense_ts, dense_mk = ts, mk
         programs[name] = (ts.make_multi_step("sparse", n_steps), mk)
+        exchange_meta[name] = {"wire_format": ts.wire_format,
+                               "total_k": int(ts.plan.total_k)}
 
-    for fn, mk in programs.values():          # compile + warm
+    for name, (fn, mk) in programs.items():   # compile + warm
         st, m = fn(mk(), batch)
         _ = float(m.loss)
+        if name in exchange_meta:
+            # measured per-step exchange payload, drained once from the
+            # warm run — the jitted step counts its own concrete buffers
+            exchange_meta[name]["bytes_sent"] = int(m.bytes_sent)
 
     out = {k: float("inf") for k in programs}
     round_times = {k: [] for k in programs}
@@ -279,6 +290,7 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
     # per-round samples for median/dispersion reporting (VERDICT r2 item 6:
     # min-of-rounds alone lets drift-band artifacts carry a headline)
     out["_rounds"] = round_times
+    out["_exchange"] = exchange_meta
     if include_dense and dense_ts is not None:
         # absolute-performance leg (VERDICT r2 item 2): the dense step's
         # HLO FLOP count is the model-FLOPs numerator for every variant's

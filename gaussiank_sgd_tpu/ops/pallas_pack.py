@@ -667,3 +667,26 @@ def gaussian_fused_ef_compress_batched(
     nsel = jnp.where(state > 0, counts,
                      jnp.sum(valid.astype(jnp.int32), axis=-1))
     return CompressResult(comp, residual, nsel), t_new
+
+
+def pack_wire_words(idx2d: jax.Array, val2d: jax.Array) -> jax.Array:
+    """Wire-pack tail of the fused select pass: chunk-local selections ->
+    one u32 word per entry (u16 bucket-relative index | bf16 value bits,
+    parallel/wire.py layout).
+
+    The fused kernel's ``CompressResult`` already carries CHUNK-LOCAL
+    ``[n_chunks, k]`` indices — exactly the bucket-relative form the wire
+    format transmits — so the packed exchange buffer is produced straight
+    from the select pass's output, before (and instead of) the global i32
+    offset materialization the legacy path needs. Like the rest of the
+    pack tail (``_select_candidates_topk`` -> ``finish_pack``) this is a
+    k-sized XLA epilogue, not an n-sized kernel pass. The caller's
+    eligibility gate guarantees the chunk span fits u16 (chunk <= 65536;
+    valid indices are < the UNPADDED chunk, and sentinel slots were
+    already mapped to index 0 with value 0 by ``finish_pack``).
+    """
+    # function-local import: ops <- compressors.registry <- parallel is the
+    # package import order; importing parallel.wire at module scope here
+    # would close the cycle during compressors/__init__
+    from ..parallel.wire import encode_entries
+    return encode_entries(idx2d, val2d)

@@ -30,13 +30,14 @@ published algorithm's behavior, kept for parity; the allgather exchange
 from __future__ import annotations
 
 import math
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from ..compressors.base import CompressedGrad
+from . import wire as wire_mod
 
 
 class GtopkCommStats(NamedTuple):
@@ -46,7 +47,10 @@ class GtopkCommStats(NamedTuple):
 
     bytes_sent: int          # summed payload bytes handed to ppermute
     rounds: int              # log2(P) butterfly rounds executed
-    entries_per_round: int   # packed (idx, val) pairs exchanged per round
+    entries_per_round: int   # packed entries exchanged per round (the
+                             # concrete per-round buffer's entry count:
+                             # (idx, val) pairs legacy, u32 words packed)
+    wire_format: str = wire_mod.WIRE_LEGACY  # format of the round payloads
 
 
 def merge_sparse(idx_a: jax.Array, val_a: jax.Array, idx_b: jax.Array,
@@ -75,8 +79,9 @@ def merge_sparse(idx_a: jax.Array, val_a: jax.Array, idx_b: jax.Array,
     return seg_idx[top].astype(jnp.int32), summed[top]
 
 
-def gtopk_allreduce(comp: CompressedGrad, num_devices: int,
-                    axis_name: str) -> Tuple[CompressedGrad, GtopkCommStats]:
+def gtopk_allreduce(comp: CompressedGrad, num_devices: int, axis_name: str,
+                    wire: Optional[wire_mod.WireFormat] = None,
+                    ) -> Tuple[CompressedGrad, GtopkCommStats]:
     """Butterfly gTop-k: log2(P) ppermute rounds; result identical on every
     worker (the global top-k of the summed sparse gradients, k entries).
 
@@ -89,6 +94,15 @@ def gtopk_allreduce(comp: CompressedGrad, num_devices: int,
     motion or a second call between trace and read cannot report a stale
     count (ADVICE r3). ``rounds``/``entries_per_round`` feed the telemetry
     stream's comms accounting (docs/OBSERVABILITY.md).
+
+    ``wire``: an active ``parallel/wire.py`` format packs each round's
+    payload as u32 words (sorted by global index + an ``int32[n_buckets]``
+    count vector — ``encode_sorted``) instead of (i32, f32) pairs. The
+    merge dedup-sums in bf16-DECODED f32 space: each round re-quantizes
+    the local values to exactly what the partner's decode yields, so both
+    butterfly sides merge identical operand sets and every worker still
+    converges to the same global top-k bit-for-bit (2-element segment
+    sums are commutative). ``wire=None`` is the legacy path, unchanged.
     """
     p = num_devices
     assert p & (p - 1) == 0, f"gtopk needs power-of-2 workers, got {p}"
@@ -99,13 +113,26 @@ def gtopk_allreduce(comp: CompressedGrad, num_devices: int,
     for r in range(n_rounds):
         stride = 1 << r
         perm = [(j, j ^ stride) for j in range(p)]
-        bytes_sent += (idx.size * idx.dtype.itemsize
-                       + val.size * val.dtype.itemsize)
-        o_idx = lax.ppermute(idx, axis_name, perm)
-        o_val = lax.ppermute(val, axis_name, perm)
+        if wire is not None:
+            # wire precision BEFORE the merge: the local copy must equal
+            # what the partner decodes, or the two sides of the butterfly
+            # would merge different values and diverge
+            val = wire_mod.bf16_roundtrip(val)
+            words, counts = wire_mod.encode_sorted(idx, val, wire)
+            bytes_sent += (words.size * words.dtype.itemsize
+                           + counts.size * counts.dtype.itemsize)
+            o_words = lax.ppermute(words, axis_name, perm)
+            o_counts = lax.ppermute(counts, axis_name, perm)
+            o_idx, o_val = wire_mod.decode_sorted(o_words, o_counts, wire)
+        else:
+            bytes_sent += (idx.size * idx.dtype.itemsize
+                           + val.size * val.dtype.itemsize)
+            o_idx = lax.ppermute(idx, axis_name, perm)
+            o_val = lax.ppermute(val, axis_name, perm)
         idx, val = merge_sparse(idx, val, o_idx, o_val, k)
-    stats = GtopkCommStats(bytes_sent=bytes_sent, rounds=n_rounds,
-                           entries_per_round=k)
+    stats = GtopkCommStats(
+        bytes_sent=bytes_sent, rounds=n_rounds, entries_per_round=k,
+        wire_format=wire.name if wire is not None else wire_mod.WIRE_LEGACY)
     return CompressedGrad(idx, val), stats
 
 

@@ -69,7 +69,12 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
                   "sel_per_bucket": ARRAY, "consecutive_skips": NUMBER,
                   "lr_scale": NUMBER, "fwd_bwd_s": NUMBER,
                   "select_s": NUMBER, "comm_update_s": NUMBER,
-                  "phase_skipped": STRING},
+                  "phase_skipped": STRING,
+                  # wire format of the bytes_sent payload (ISSUE 5,
+                  # parallel/wire.py): "u16bf16" packed or "i32f32"
+                  # legacy — a bytes claim never travels without its
+                  # format name (BASELINE.md protocol)
+                  "wire_format": STRING},
     ),
     "eval": EventSchema(
         required={"step": NUMBER, "epoch": NUMBER, "val_loss": NUMBER},
@@ -117,7 +122,11 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
                   # HBM floor (analysis/roofline.py artifact)
                   "rounds": NUMBER, "overhead_ms": NUMBER,
                   "roofline_floor_ms": NUMBER,
-                  "overhead_vs_floor": NUMBER},
+                  "overhead_vs_floor": NUMBER,
+                  # comms wire accounting (ISSUE 5, parallel/wire.py):
+                  # the fixed selector's measured per-step exchange
+                  # payload and the format it was packed in
+                  "wire_format": STRING, "bytes_sent": NUMBER},
     ),
     "bench_summary": EventSchema(
         required={"metric": STRING, "value": NUMBER,

@@ -68,6 +68,11 @@ class TrainConfig:
                                             # randomkec shared-vs-decorrelated
                                             # seed ablation, VERDICT r5 #6;
                                             # analysis/randomkec_decorrelated)
+    wire: str = "auto"                      # sparse-exchange wire format
+                                            # (parallel/wire.py): 'auto' =
+                                            # packed u16+bf16 when eligible,
+                                            # 'off' = always legacy i32+f32
+                                            # (the bf16-vs-f32 parity arm)
 
     # numerics
     compute_dtype: str = "bfloat16"         # MXU-native compute
@@ -209,6 +214,10 @@ def add_args(p: argparse.ArgumentParser, suppress_defaults: bool = False) -> Non
                    help="fold the worker index into the compressor RNG "
                         "(randomkec seed ablation; see "
                         "analysis/randomkec_decorrelated.py)")
+    p.add_argument("--wire", choices=("auto", "off"), default=d.wire,
+                   help="sparse-exchange wire format (parallel/wire.py): "
+                        "auto = packed u16+bf16 when the plan is eligible, "
+                        "off = always the legacy i32+f32 format")
     p.add_argument("--compress-warmup-steps", dest="compress_warmup_steps",
                    type=int, default=d.compress_warmup_steps)
     p.add_argument("--fold-lr", dest="fold_lr",

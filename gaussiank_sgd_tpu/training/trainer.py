@@ -325,6 +325,7 @@ class Trainer:
             flat_opt=flat_opt,
             guard_nonfinite=cfg.nonfinite_guard,
             decorrelate_comp_rng=cfg.decorrelate_comp_rng,
+            wire=cfg.wire,
         )
         # drop caches keyed on the replaced programs (phase-timing probes,
         # first-dispatch bookkeeping)
@@ -685,6 +686,12 @@ class Trainer:
             "skipped": float(jax.device_get(m.skipped)),
             "nonfinite": float(jax.device_get(m.nonfinite)),
         }
+        if not self._in_warmup(step):
+            # the payload's wire format travels with every sparse bytes
+            # claim (ISSUE 5 protocol: "u16bf16" packed / "i32f32"
+            # legacy); warm-up steps move a dense f32 allreduce instead,
+            # so the field would be a lie there — omitted
+            rec["wire_format"] = self.ts.wire_format
         if len(self.plan.buckets) > 1:
             # per-bucket selection counts (dp-mean); single-bucket plans
             # skip the column — it would duplicate num_selected

@@ -115,5 +115,8 @@ def test_trainstep_gtopk_exchange_converges():
         state, m = ts.sparse_step(state, sb)
         losses.append(float(m.loss))
     assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
-    # bytes metric reflects k*log2(P) rounds
-    assert int(m.bytes_sent) == ts.plan.total_k * 8 * 3
+    # bytes metric reflects log2(P)=3 butterfly rounds on the packed wire:
+    # k u32 words + one i32 per-bucket count per round (parallel/wire.py)
+    assert ts.wire_format == "u16bf16"
+    n_buckets = len(ts.plan.buckets)
+    assert int(m.bytes_sent) == (ts.plan.total_k + n_buckets) * 4 * 3

@@ -76,7 +76,9 @@ def test_sparse_full_density_matches_dense():
     opt = optax.sgd(0.05, momentum=0.9)
     spec = get_compressor("topk", density=1.0)
     plan = plan_for_params(params, 1.0)
-    ts = build_dp_train_step(loss_fn, opt, spec, plan, mesh)
+    # wire="off": dense==sparse equality at rtol 1e-5 needs the exchange
+    # values untouched; the bf16 wire would add ~2^-8 relative error
+    ts = build_dp_train_step(loss_fn, opt, spec, plan, mesh, wire="off")
     batch = shard_batch(mesh, make_batch(64))
 
     s_dense = ts.init_state(params, jax.random.PRNGKey(0))
@@ -225,12 +227,24 @@ def test_grad_clipping():
 
 
 def test_metrics_fields():
+    # this tiny single-bucket f32 plan is wire-eligible (parallel/wire.py),
+    # so the exchange moves one packed u32 word per entry
     ts, state, make_batch, mesh = build("gaussian", density=0.1)
     batch = shard_batch(mesh, make_batch(64))
     state, m = ts.sparse_step(state, batch)
+    assert ts.wire_format == "u16bf16"
     assert m.bytes_sent.dtype == jnp.float32  # f32: no int32 wrap at scale
-    assert int(m.bytes_sent) == ts.plan.total_k * 8
+    assert int(m.bytes_sent) == ts.plan.total_k * 4
     assert int(m.num_selected) >= 0
+
+
+def test_metrics_fields_wire_off():
+    # wire="off" keeps the legacy i32+f32 pair: 8 bytes per entry
+    ts, state, make_batch, mesh = build("gaussian", density=0.1, wire="off")
+    batch = shard_batch(mesh, make_batch(64))
+    state, m = ts.sparse_step(state, batch)
+    assert ts.wire_format == "i32f32"
+    assert int(m.bytes_sent) == ts.plan.total_k * 8
 
 
 def test_flat_opt_matches_optax_trajectory():

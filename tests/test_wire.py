@@ -1,0 +1,259 @@
+"""Tests for the compact 32-bit wire format (ISSUE 5, parallel/wire.py).
+
+Codec-level: round trips at every bucket boundary the u16 relative index
+can reach, bf16 value error bounded by 1 ulp, both layout codecs (grouped
+allgather, sorted+counts gtopk). Integration-level: EF residual bit-parity
+and gtopk dedup-sum parity between the packed and legacy wire when the
+exchanged values are exactly bf16-representable, so any deviation is a
+codec bug and not quantization.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from gaussiank_sgd_tpu.compressors import CompressedGrad, get_compressor
+from gaussiank_sgd_tpu.parallel import wire
+from gaussiank_sgd_tpu.parallel.bucketing import make_bucket_plan
+from gaussiank_sgd_tpu.parallel.mesh import data_parallel_mesh, shard_batch
+from gaussiank_sgd_tpu.parallel.trainstep import build_dp_train_step
+
+# ---------------------------------------------------------------- codec
+
+
+def test_entry_roundtrip_rel_boundaries():
+    """rel 0 and rel 65535 (the u16 extremes) survive the word layout."""
+    rel = jnp.asarray([0, 1, 255, 256, 65534, 65535], jnp.int32)
+    val = jnp.asarray([1.0, -2.0, 0.5, -0.25, 3.0, -4.0], jnp.float32)
+    r2, v2 = wire.decode_entries(wire.encode_entries(rel, val))
+    np.testing.assert_array_equal(np.asarray(r2), np.asarray(rel))
+    # powers of two are bf16-exact: the values come back bitwise
+    np.testing.assert_array_equal(np.asarray(v2), np.asarray(val))
+
+
+def test_entry_value_error_at_most_one_ulp():
+    vals = jax.random.normal(jax.random.PRNGKey(0), (4096,), jnp.float32)
+    vals = vals * jnp.logspace(-20, 20, 4096, dtype=jnp.float32)
+    _, back = wire.decode_entries(
+        wire.encode_entries(jnp.zeros((4096,), jnp.int32), vals))
+    err = np.abs(np.asarray(back) - np.asarray(vals))
+    # bf16 keeps 8 mantissa bits: round-to-nearest error <= 2^-9 relative
+    # (1/2 ulp), bounded here by the full ulp 2^-8
+    assert np.all(err <= np.abs(np.asarray(vals)) * 2.0 ** -8 + 1e-38)
+
+
+def test_entry_special_values():
+    """Zero, negative zero, and the bf16 dynamic-range ends round-trip."""
+    val = jnp.asarray([0.0, -0.0, 1e-38, -1e38, 3.389e38], jnp.float32)
+    _, back = wire.decode_entries(
+        wire.encode_entries(jnp.zeros((5,), jnp.int32), val))
+    got = np.asarray(back)
+    assert got[0] == 0.0 and got[1] == 0.0
+    assert np.signbit(got[1]) and not np.signbit(got[0])
+    assert np.all(np.isfinite(got))
+
+
+def _grouped_comp(wf, slots, rng=0):
+    """A bucket-major CompressedGrad with entries pinned to the rel
+    extremes of every bucket (offset 0, offset chunk-1) plus random fill,
+    including the trailing pad bucket of a non-multiple total."""
+    key = jax.random.PRNGKey(rng)
+    rel = jax.random.randint(key, (wf.n_buckets, slots), 0, wf.chunk)
+    rel = rel.at[:, 0].set(0).at[:, 1].set(wf.chunk - 1)
+    base = jnp.arange(wf.n_buckets, dtype=jnp.int32)[:, None] * wf.chunk
+    idx = (base + rel).reshape(-1)
+    val = jnp.round(jax.random.normal(
+        jax.random.PRNGKey(rng + 1), (wf.n_buckets * slots,)) * 8) / 8
+    return CompressedGrad(idx, val.astype(jnp.float32))
+
+
+def test_grouped_roundtrip_at_bucket_boundaries():
+    # 200000 elements under 65536-chunks: 4 buckets, the last one ~71%
+    # padding — exactly the shape the allgather path ships
+    plan = make_bucket_plan([200_000], 0.001, bucket_size=65_536,
+                            policy="uniform")
+    wf = wire.plan_wire_format(plan, jnp.float32)
+    assert wf is not None and wf.chunk == 65_536 and wf.n_buckets == 4
+    comp = _grouped_comp(wf, slots=66)
+    words = wire.encode_grouped(comp, wf)
+    assert words.dtype == jnp.uint32 and words.size == comp.indices.size
+    back = wire.decode_grouped(words, wf, comp.indices.shape[0])
+    np.testing.assert_array_equal(np.asarray(back.indices),
+                                  np.asarray(comp.indices))
+    # 1/8-grid values are bf16-exact for this magnitude range
+    np.testing.assert_array_equal(np.asarray(back.values),
+                                  np.asarray(comp.values))
+
+
+def test_grouped_decode_multiworker_payload():
+    """decode_grouped on a tiled allgather buffer reconstructs each
+    worker's bucket ids from the position WITHIN its payload."""
+    plan = make_bucket_plan([1024], 0.01, bucket_size=256, policy="uniform")
+    wf = wire.plan_wire_format(plan, jnp.float32)
+    assert wf is not None
+    comps = [_grouped_comp(wf, slots=4, rng=r) for r in range(3)]
+    gathered = jnp.concatenate(
+        [wire.encode_grouped(c, wf) for c in comps])
+    back = wire.decode_grouped(gathered, wf, comps[0].indices.shape[0])
+    want_idx = np.concatenate([np.asarray(c.indices) for c in comps])
+    np.testing.assert_array_equal(np.asarray(back.indices), want_idx)
+
+
+def test_grouped_rejects_ragged_payload():
+    plan = make_bucket_plan([512], 0.01, bucket_size=256, policy="uniform")
+    wf = wire.plan_wire_format(plan, jnp.float32)
+    with pytest.raises(ValueError):
+        wire.encode_grouped(
+            CompressedGrad(jnp.zeros((5,), jnp.int32),
+                           jnp.zeros((5,), jnp.float32)), wf)
+    with pytest.raises(ValueError):
+        wire.decode_grouped(jnp.zeros((5,), jnp.uint32), wf, 4)
+
+
+def test_sorted_roundtrip():
+    plan = make_bucket_plan([1000], 0.05, bucket_size=300, policy="uniform")
+    wf = wire.plan_wire_format(plan, jnp.float32)
+    assert wf is not None and wf.n_buckets == 4
+    idx = jnp.asarray([999, 0, 299, 300, 601, 42], jnp.int32)
+    val = jnp.asarray([1.0, -2.0, 0.5, 4.0, -0.125, 8.0], jnp.float32)
+    words, counts = wire.encode_sorted(idx, val, wf)
+    assert int(counts.sum()) == idx.size
+    i2, v2 = wire.decode_sorted(words, counts, wf)
+    got = dict(zip(np.asarray(i2).tolist(), np.asarray(v2).tolist()))
+    want = dict(zip(np.asarray(idx).tolist(), np.asarray(val).tolist()))
+    assert got == want
+    # the decoded stream is sorted by global index — the invariant the
+    # butterfly merge's bitwise cross-worker agreement rests on
+    assert np.all(np.diff(np.asarray(i2)) >= 0)
+
+
+# ------------------------------------------------------ eligibility gate
+
+
+def test_gate_accepts_chunk_exactly_65536():
+    plan = make_bucket_plan([200_000], 0.001, bucket_size=65_536,
+                            policy="uniform")
+    wf = wire.plan_wire_format(plan, jnp.float32)
+    assert wf is not None and wf.name == wire.WIRE_PACKED
+
+
+def test_gate_rejects_oversized_chunk():
+    plan = make_bucket_plan([200_000], 0.001, bucket_size=131_072,
+                            policy="uniform")
+    assert wire.plan_wire_format(plan, jnp.float32) is None
+
+
+def test_gate_rejects_non_f32_grads():
+    plan = make_bucket_plan([4096], 0.01, bucket_size=1024,
+                            policy="uniform")
+    assert wire.plan_wire_format(plan, jnp.bfloat16) is None
+    assert wire.plan_wire_format(plan, jnp.float32) is not None
+
+
+def test_gate_rejects_non_uniform_plan():
+    # greedy over unequal tensors: two buckets of different size
+    plan = make_bucket_plan([700, 300], 0.01, bucket_size=0)
+    assert not plan.uniform
+    assert wire.plan_wire_format(plan, jnp.float32) is None
+
+
+def test_gate_accepts_single_greedy_bucket():
+    # one greedy bucket is trivially uniform — the small-model default
+    plan = make_bucket_plan([676], 0.1)
+    assert plan.uniform
+    wf = wire.plan_wire_format(plan, jnp.float32)
+    assert wf is not None and wf.n_buckets == 1 and wf.chunk == 676
+
+
+# ------------------------------------------------- trainstep integration
+
+
+def _bf16_exact_problem(dim=32):
+    """A linear regression whose first-step gradients are powers of two
+    (bf16-exact), with IDENTICAL shards on every worker — so the packed
+    and legacy wires must produce bitwise-identical states."""
+    w0 = np.zeros(dim, np.float32)
+
+    def loss_fn(p, mstate, batch, rng):
+        x, y = batch
+        pred = x @ p["w"]
+        return jnp.mean((pred - y) ** 2), (mstate, {})
+
+    # 16 rows (2 per worker on the 8-way mesh), row b hits coordinate
+    # b % dim with a power-of-two target: grad_j = -2*mean(x_bj * y_b)
+    # lands on the dyadic grid at every worker
+    nrow = 16
+    x = np.zeros((nrow, dim), np.float32)
+    y = np.zeros((nrow,), np.float32)
+    for b in range(nrow):
+        x[b, b % dim] = 1.0
+        y[b] = 2.0 ** ((b % 4) - 1)
+    return {"w": jnp.asarray(w0)}, loss_fn, (jnp.asarray(x), jnp.asarray(y))
+
+
+@pytest.mark.parametrize("exchange", ["allgather", "gtopk"])
+def test_trainstep_bitwise_parity_on_bf16_exact_values(exchange):
+    """With bf16-exact exchanged values, wire='auto' and wire='off' agree
+    BITWISE on params and EF residual after a step: the packed format is
+    pure transport, and EF bit-parity shows the quantization-error
+    feedback term is exactly zero when there is no quantization error."""
+    states = {}
+    for w in ("auto", "off"):
+        params, loss_fn, batch = _bf16_exact_problem()
+        mesh = data_parallel_mesh()
+        comp = get_compressor("topk", density=0.25)
+        plan = make_bucket_plan([32], 0.25)
+        ts = build_dp_train_step(loss_fn, optax.sgd(0.25), comp, plan,
+                                 mesh, exchange=exchange, wire=w)
+        assert ts.wire_format == (wire.WIRE_PACKED if w == "auto"
+                                  else wire.WIRE_LEGACY)
+        state = ts.init_state(params, jax.random.PRNGKey(0))
+        sb = shard_batch(mesh, batch)
+        for _ in range(2):
+            state, m = ts.sparse_step(state, sb)
+        states[w] = (np.asarray(state.params["w"]),
+                     np.asarray(state.ef_residual), int(m.bytes_sent))
+    np.testing.assert_array_equal(states["auto"][0], states["off"][0])
+    np.testing.assert_array_equal(states["auto"][1], states["off"][1])
+    # and the packed wire really moved fewer bytes while agreeing
+    assert states["auto"][2] < states["off"][2]
+
+
+def test_trainstep_ef_absorbs_bf16_error():
+    """When values are NOT bf16-exact, the packed wire must leave EXACTLY
+    ``v - bf16(v)`` in the EF residual at every sent coordinate — nothing
+    silently dropped, nothing double-counted."""
+    dim, nrow = 32, 16
+    # y off the dyadic grid: gradients at w=0 are -y_b at coordinate b,
+    # NOT bf16-representable (9 significant mantissa bits)
+    x = np.zeros((nrow, dim), np.float32)
+    y = np.zeros((nrow,), np.float32)
+    for b in range(nrow):
+        x[b, b] = 1.0
+        y[b] = np.float32(2.0 ** ((b % 4) - 1)) * np.float32(1 + 2.0 ** -9)
+
+    def loss_fn(p, mstate, batch, rng):
+        xb, yb = batch
+        return jnp.mean((xb @ p["w"] - yb) ** 2), (mstate, {})
+
+    mesh = data_parallel_mesh()
+    comp = get_compressor("topk", density=0.25)
+    plan = make_bucket_plan([dim], 0.25)
+    ts = build_dp_train_step(loss_fn, optax.sgd(0.05), comp, plan, mesh)
+    assert ts.wire_format == wire.WIRE_PACKED     # default wire="auto"
+    state = ts.init_state({"w": jnp.zeros((dim,))}, jax.random.PRNGKey(0))
+    state, _ = ts.sparse_step(
+        state, shard_batch(mesh, (jnp.asarray(x), jnp.asarray(y))))
+
+    # worker w sees rows 2w, 2w+1: its grad is -y_b at coords 2w, 2w+1
+    # (both inside its top-8), zero elsewhere — so its residual must be
+    # exactly the bf16 rounding error of -y_b there and zero elsewhere
+    qerr = np.asarray(-jnp.asarray(y)
+                      - wire.bf16_roundtrip(-jnp.asarray(y)))
+    expected = np.zeros((8, dim), np.float32)
+    for b in range(nrow):
+        expected[b // 2, b] = qerr[b]
+    got = np.asarray(state.ef_residual).reshape(8, dim)
+    np.testing.assert_array_equal(got, expected)
