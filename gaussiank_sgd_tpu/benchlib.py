@@ -203,19 +203,30 @@ def _run_once(multi_step, mk_state, batch, n_steps):
 
 def bench_model(model: str, dataset: str, batch_size: int, density: float,
                 compressors: Sequence[str], n_steps: int, rounds: int = 8,
+                windows: int = 1,
                 include_dense: bool = True, model_kwargs: Optional[dict] = None,
                 dtype=jnp.bfloat16, bucket_policy: str = "greedy",
                 bucket_size: Optional[int] = None) -> Dict[str, float]:
     """Per-step seconds for the dense program + each compressor's sparse
     program on one model. Timing keys: 'dense' + compressor names.
     Underscore-prefixed keys are metadata, NOT timings: ``_rounds``
-    (per-round samples, dict of lists), ``_dense_step_flops`` and
+    (per-round samples pooled over all windows, dict of lists),
+    ``_windows`` (the same samples grouped per measurement window:
+    dict of ``windows`` lists of ``rounds`` samples — consumers compute
+    per-window paired medians from it, ISSUE 6 measurement-power
+    satellite), ``_dense_step_flops`` and
     ``_peak_flops`` (MFU inputs), ``_exchange`` (per-compressor wire
     accounting: the build's wire format name, its measured per-step
     ``bytes_sent`` drained from the warm run's StepMetrics, and the
     plan's total_k — the bytes are the concrete exchanged buffers'
     count, parallel/wire.py) — consumers iterating the dict must
     filter them.
+
+    ``windows``: repeat the whole ``rounds``-round interleaved block this
+    many times. Windows are farther apart in wall-clock than rounds, so
+    slow machine drift (thermal state, co-tenant load) lands BETWEEN
+    windows; a claim that holds for the min across window medians is one
+    that survives re-measurement.
 
     ``bucket_policy``/``bucket_size``: the selection-unit plan (SURVEY.md
     §2.3 bucketing). The VERDICT-r2 scaling recipe for 20M+ LM models is
@@ -278,18 +289,29 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
 
     out = {k: float("inf") for k in programs}
     round_times = {k: [] for k in programs}
+    window_times = {k: [] for k in programs}
     names = list(programs)
-    for r in range(rounds):
-        # rotate the within-round order — a fixed order hands whatever
-        # first-slot penalty exists to the same variant every round
-        for name in names[r % len(names):] + names[:r % len(names)]:
-            fn, mk = programs[name]
-            t = _run_once(fn, mk, batch, n_steps)
-            round_times[name].append(t)
-            out[name] = min(out[name], t)
+    for w in range(max(1, int(windows))):
+        wt = {k: [] for k in programs}
+        for r in range(rounds):
+            # rotate the within-round order (continuously across windows)
+            # — a fixed order hands whatever first-slot penalty exists to
+            # the same variant every round
+            g = w * rounds + r
+            for name in names[g % len(names):] + names[:g % len(names)]:
+                fn, mk = programs[name]
+                t = _run_once(fn, mk, batch, n_steps)
+                wt[name].append(t)
+                round_times[name].append(t)
+                out[name] = min(out[name], t)
+        for k in programs:
+            window_times[k].append(wt[k])
     # per-round samples for median/dispersion reporting (VERDICT r2 item 6:
-    # min-of-rounds alone lets drift-band artifacts carry a headline)
+    # min-of-rounds alone lets drift-band artifacts carry a headline), plus
+    # the same samples grouped per window (min-across-window-medians
+    # reporting, ISSUE 6)
     out["_rounds"] = round_times
+    out["_windows"] = window_times
     out["_exchange"] = exchange_meta
     if include_dense and dense_ts is not None:
         # absolute-performance leg (VERDICT r2 item 2): the dense step's

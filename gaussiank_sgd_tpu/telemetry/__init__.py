@@ -22,7 +22,7 @@ from .bus import EventBus
 from .events import SCHEMA_VERSION, validate_record, validate_stream
 from .exporters import (Exporter, JSONLExporter, MemoryExporter,
                         PrometheusTextfileExporter)
-from .throughput import ThroughputTracker
+from .throughput import ThroughputSignals, ThroughputTracker
 
 __all__ = [
     "EventBus",
@@ -31,6 +31,7 @@ __all__ = [
     "MemoryExporter",
     "PrometheusTextfileExporter",
     "SCHEMA_VERSION",
+    "ThroughputSignals",
     "ThroughputTracker",
     "validate_record",
     "validate_stream",

@@ -123,6 +123,12 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
                   "rounds": NUMBER, "overhead_ms": NUMBER,
                   "roofline_floor_ms": NUMBER,
                   "overhead_vs_floor": NUMBER,
+                  # measurement-power fields (ISSUE 6): rounds are run in
+                  # M independent windows; per-window paired medians ship
+                  # with the record and the config's headline ratio is
+                  # their MIN, so a >= 0.90 claim survives re-measurement
+                  "windows": NUMBER, "window_medians": ARRAY,
+                  "ratio_window_min": NUMBER,
                   # comms wire accounting (ISSUE 5, parallel/wire.py):
                   # the fixed selector's measured per-step exchange
                   # payload and the format it was packed in
@@ -131,7 +137,22 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
     "bench_summary": EventSchema(
         required={"metric": STRING, "value": NUMBER,
                   "worst_config": STRING},
-        optional={"smoke": NUMBER},     # bool passes NUMBER (see above)
+        optional={"smoke": NUMBER,      # bool passes NUMBER (see above)
+                  "windows": NUMBER, "rounds": NUMBER},
+    ),
+    # adaptive policy engine (docs/ADAPTIVE.md): knob retunes applied at
+    # the recompile-safe boundary, and probation reverts; published from
+    # the trainer thread (never from the engine's bus-exporter side)
+    "policy_decision": EventSchema(
+        required={"step": NUMBER, "rule": STRING, "knob": STRING,
+                  "old": STRING, "new": STRING, "reason": STRING},
+        optional={"recompiles": NUMBER, "budget_left": NUMBER},
+    ),
+    "policy_revert": EventSchema(
+        required={"step": NUMBER, "rule": STRING, "knob": STRING,
+                  "old": STRING, "new": STRING, "reason": STRING},
+        optional={"recompiles": NUMBER, "budget_left": NUMBER,
+                  "quarantined": NUMBER},   # bool passes NUMBER
     ),
 }
 

@@ -73,6 +73,15 @@ class TrainConfig:
                                             # packed u16+bf16 when eligible,
                                             # 'off' = always legacy i32+f32
                                             # (the bf16-vs-f32 parity arm)
+    policy: str = "static"                  # 'adaptive' = telemetry-driven
+                                            # policy engine retunes selector/
+                                            # density/wire/bucket-plan at
+                                            # recompile-safe boundaries
+                                            # (gaussiank_sgd_tpu/policy/,
+                                            # docs/ADAPTIVE.md); 'static' =
+                                            # knobs stay exactly as
+                                            # configured (bit-identical to
+                                            # pre-policy behavior)
 
     # numerics
     compute_dtype: str = "bfloat16"         # MXU-native compute
@@ -218,6 +227,12 @@ def add_args(p: argparse.ArgumentParser, suppress_defaults: bool = False) -> Non
                    help="sparse-exchange wire format (parallel/wire.py): "
                         "auto = packed u16+bf16 when the plan is eligible, "
                         "off = always the legacy i32+f32 format")
+    p.add_argument("--policy", choices=("static", "adaptive"),
+                   default=d.policy,
+                   help="adaptive = close the loop from telemetry to "
+                        "selector/density/wire/bucket retuning at "
+                        "recompile-safe boundaries (docs/ADAPTIVE.md); "
+                        "static = knobs stay as configured")
     p.add_argument("--compress-warmup-steps", dest="compress_warmup_steps",
                    type=int, default=d.compress_warmup_steps)
     p.add_argument("--fold-lr", dest="fold_lr",

@@ -79,7 +79,7 @@ class ResilienceMonitor:
     already-synced host scalars; ``should_rollback`` is consulted once per
     log interval (ISSUE contract) and returns a reason string or None."""
 
-    def __init__(self, policy: ResiliencePolicy):
+    def __init__(self, policy: ResiliencePolicy, on_anomaly=None):
         self.policy = policy
         self.consecutive_skips = 0
         self.total_skips = 0
@@ -88,11 +88,18 @@ class ResilienceMonitor:
         self._ema_obs = 0
         self._pending: Optional[str] = None
         self._pending_step: Optional[int] = None
+        # optional (reason, step) callback fired the moment an anomaly
+        # first becomes pending — the adaptive policy engine's safety-net
+        # hookup (docs/ADAPTIVE.md): a decision preceding an anomaly is
+        # reverted BEFORE the rollback executes
+        self._on_anomaly = on_anomaly
 
     def _set_pending(self, reason: str, step: int) -> None:
         if self._pending is None:
             self._pending = reason
             self._pending_step = step
+            if self._on_anomaly is not None:
+                self._on_anomaly(reason, step)
 
     def observe(self, step: int, loss: float, skipped: float) -> None:
         p = self.policy

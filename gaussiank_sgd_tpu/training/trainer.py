@@ -205,6 +205,29 @@ class Trainer:
             max_rollbacks=cfg.max_rollbacks)
         self.monitor = ResilienceMonitor(policy) if policy.active else None
 
+        # ---- adaptive policy engine (docs/ADAPTIVE.md) ----
+        # default 'static' builds NO engine object at all: the train loop's
+        # policy branch is `if self.engine is not None` and everything else
+        # is untouched, so static runs stay bit-identical to pre-policy
+        # behavior
+        self.engine = None
+        if cfg.policy == "adaptive":
+            if self.is_dense_only:
+                raise ValueError(
+                    "--policy adaptive retunes the sparse exchange; "
+                    "--compressor none has no knobs to retune")
+            from ..policy import (PolicyEngine, default_rules,
+                                  load_roofline_floor)
+            floor = load_roofline_floor(cfg.dnn, jax.default_backend())
+            self.engine = PolicyEngine(
+                default_rules(cfg),
+                publish=lambda event, payload: self.bus.publish(
+                    {"event": event, **payload}),
+                knobs=self._policy_knobs(), floor_ms=floor)
+            # the engine rides the bus as an exporter: its emit() only
+            # ingests signals (never publishes — the bus lock is held)
+            self.bus.attach(self.engine)
+
         # ---- eval step: shard_map'd sum-reduce over dp ----
         eval_fn = make_eval_fn(self.spec, recurrent=self.recurrent,
                                input_norm=input_norm)
@@ -447,6 +470,114 @@ class Trainer:
         self.state = state      # setter: drops data iter + step cache
 
     # ------------------------------------------------------------------
+    # adaptive policy plumbing (docs/ADAPTIVE.md)
+    def _policy_knobs(self) -> Dict[str, str]:
+        """Current knob values in the string form PolicyDecisions carry."""
+        from ..policy import (KNOB_BUCKET, KNOB_COMPRESSOR, KNOB_DENSITY,
+                              KNOB_WIRE)
+        cfg = self.cfg
+        size = "" if cfg.bucket_size is None else str(cfg.bucket_size)
+        return {KNOB_COMPRESSOR: self._comp.name,
+                KNOB_DENSITY: f"{cfg.density:g}",
+                KNOB_WIRE: cfg.wire,
+                KNOB_BUCKET: f"{cfg.bucket_policy}:{size}"}
+
+    def _apply_policy(self, decision) -> None:
+        """Apply one PolicyDecision at the recompile-safe boundary: mutate
+        the knob, rebuild compressor/plan as needed, rebuild the jitted
+        programs, and re-shape the live TrainState for the new program
+        layout (:meth:`_rebuild_for_policy`)."""
+        from ..policy import (KNOB_BUCKET, KNOB_COMPRESSOR, KNOB_DENSITY,
+                              KNOB_WIRE)
+        cfg = self.cfg
+        knob, value = decision.knob, decision.new
+        if knob == KNOB_COMPRESSOR:
+            self._comp = get_compressor(value, density=cfg.density,
+                                        sigma_scale=cfg.sigma_scale)
+            cfg.compressor = value
+        elif knob == KNOB_DENSITY:
+            cfg.density = float(value)
+            self._comp = get_compressor(cfg.compressor, density=cfg.density,
+                                        sigma_scale=cfg.sigma_scale)
+            # per-bucket k is derived from density: the plan must re-derive
+            self.plan = plan_for_params(self._state.params, cfg.density,
+                                        cfg.bucket_size,
+                                        policy=cfg.bucket_policy)
+        elif knob == KNOB_WIRE:
+            cfg.wire = value
+        elif knob == KNOB_BUCKET:
+            pol, _, size = value.partition(":")
+            cfg.bucket_policy = pol
+            cfg.bucket_size = int(size) if size else None
+            self.plan = plan_for_params(self._state.params, cfg.density,
+                                        cfg.bucket_size,
+                                        policy=cfg.bucket_policy)
+        else:
+            raise ValueError(f"unknown policy knob {knob!r}")
+        self._rebuild_for_policy()
+
+    def _rebuild_for_policy(self) -> None:
+        """Rebuild the step programs for retuned knobs and migrate the
+        live TrainState across the layout change. Params/opt/step/rng are
+        layout-invariant; the EF residual follows the checkpoint-edge
+        contract (strip the fused-EF block pad to the canonical
+        [P, total_numel], re-pad for the new program — one bounded host
+        round-trip, never in the jitted path); a stateful compressor's
+        warm-threshold carry is re-initialized fresh (its old thresholds
+        priced a different selector/plan)."""
+        old_ef = self.ts.ef_numel
+        state = self._state
+        self._build_steps()
+        new_ef = self.ts.ef_numel
+        ef = state.ef_residual
+        nworkers = self.mesh.size
+        if new_ef != old_ef:
+            n = self.plan.total_numel
+            mat = np.asarray(jax.device_get(ef)).reshape(
+                nworkers, old_ef)[:, :n]
+            pad = np.zeros((nworkers, new_ef), mat.dtype)
+            pad[:, :n] = mat
+            ef = pad.reshape(-1)
+        # init_state re-shards EF and builds a right-shaped comp_state for
+        # the new program; everything trajectory-carrying is copied over
+        fresh = self.ts.init_state(state.params, state.rng,
+                                   model_state=state.model_state,
+                                   carry=state.carry)
+        fresh = fresh._replace(
+            step=state.step, opt_state=state.opt_state,
+            ef_residual=jnp.asarray(ef))
+        self.state = fresh      # setter: drops data iter + step cache
+
+    def _policy_tick(self, rollback_pending: bool) -> None:
+        """One boundary tick of the closed loop: probation watchdog first
+        (a bad decision reverts BEFORE any rollback executes, so the
+        restored checkpoint meets the pre-decision program layout), then —
+        quiet intervals only — the next decision. Every apply/revert seals
+        a checkpoint so a later rollback always has a target matching the
+        current layout."""
+        eng = self.engine
+        revert = eng.check_revert(rollback_pending=rollback_pending)
+        if revert is not None:
+            self._apply_policy(revert)
+            eng.note_reverted(revert)
+            self.logger.warning("policy revert %s: %s -> %s (%s)",
+                                revert.knob, revert.old, revert.new,
+                                revert.reason)
+            if not rollback_pending:
+                self._save_checkpoint()
+            return
+        if rollback_pending:
+            return
+        decision = eng.decide()
+        if decision is not None:
+            self._apply_policy(decision)
+            eng.note_applied(decision)
+            self.logger.info("policy decision [%s] %s: %s -> %s (%s)",
+                             decision.rule, decision.knob, decision.old,
+                             decision.new, decision.reason)
+            self._save_checkpoint()
+
+    # ------------------------------------------------------------------
     def _dummy_inputs(self):
         shape = (2,) + self.spec.input_shape
         if self.spec.task == "seq2seq":
@@ -561,12 +692,18 @@ class Trainer:
                 raise TrainingPreempted(done, path)
             if done % cfg.log_every == 0:
                 last = self._log_train(done, m)
-                if self.monitor is not None:
-                    # policy ACTS only at log intervals (ISSUE contract);
-                    # between intervals it only accumulates observations
-                    reason = self.monitor.should_rollback()
-                    if reason:
-                        self._rollback(reason)
+                # policy/resilience ACT only at log intervals (ISSUE
+                # contract); between intervals they only accumulate
+                # observations. Order matters: the engine's probation
+                # watchdog runs BEFORE a pending rollback executes, so a
+                # bad decision's knobs are reverted first and the restored
+                # checkpoint meets the pre-decision program layout.
+                reason = (self.monitor.should_rollback()
+                          if self.monitor is not None else None)
+                if self.engine is not None:
+                    self._policy_tick(rollback_pending=reason is not None)
+                if reason:
+                    self._rollback(reason)
         if losses and not last:
             last = self._log_train(self.step, losses[-1], quiet=True)
         return last
@@ -698,14 +835,16 @@ class Trainer:
             rec["sel_per_bucket"] = [
                 round(float(v), 2)
                 for v in np.asarray(jax.device_get(m.sel_per_bucket))]
-        ex_s = self.tracker.examples_per_s
-        if ex_s is not None:
-            rec["ex_per_s"] = round(ex_s, 3)
         self._maybe_probe_mfu(self.ts.dense_step if self._in_warmup(step)
                               else self.ts.sparse_step)
-        mfu = self.tracker.mfu(self._flops_per_step, self._peak_flops)
-        if mfu is not None:
-            rec["mfu"] = round(mfu, 5)
+        # ONE canonical tracker snapshot per interval (ISSUE 6 satellite):
+        # the log line, the bus record, and the policy engine all read the
+        # same consistent numbers instead of racing per-field properties
+        sig = self.tracker.signals(self._flops_per_step, self._peak_flops)
+        if sig.examples_per_s is not None:
+            rec["ex_per_s"] = round(sig.examples_per_s, 3)
+        if sig.mfu is not None:
+            rec["mfu"] = round(sig.mfu, 5)
         if self.monitor is not None:
             rec["consecutive_skips"] = self.monitor.consecutive_skips
             rec["lr_scale"] = self._lr_scale

@@ -79,3 +79,22 @@ def test_milestones_list_becomes_tuple(tmp_path):
     c = tmp_path / "c.json"
     c.write_text(json.dumps({"lr_milestones": [0.3, 0.6]}))
     assert parse(["--config", str(c)]).lr_milestones == (0.3, 0.6)
+
+
+def test_policy_field_precedence(tmp_path):
+    """--policy rides the documented precedence chain (defaults < config
+    file < explicit CLI flag) and defaults to static — an unflagged run
+    is bit-identical to pre-policy behavior (ISSUE 6 satellite)."""
+    assert TrainConfig().policy == "static"
+    assert parse([]).policy == "static"
+    # every committed exp config pins the field explicitly
+    for path in CONFIGS:
+        assert json.load(open(path))["policy"] == "static"
+        assert parse(["--config", path]).policy == "static"
+    c = tmp_path / "c.json"
+    c.write_text(json.dumps({"dnn": "resnet20", "policy": "adaptive"}))
+    assert parse(["--config", str(c)]).policy == "adaptive"
+    # explicit CLI flag beats the file, even at the default value
+    assert parse(["--config", str(c), "--policy", "static"]).policy \
+        == "static"
+    assert parse(["--policy", "adaptive"]).policy == "adaptive"

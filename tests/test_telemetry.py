@@ -225,6 +225,36 @@ def test_tracker_window_mfu_and_validation():
     assert tr.mfu(None, 4e12) is None and tr.mfu(2e12, None) is None
 
 
+def test_tracker_signals_snapshot_is_one_canonical_view():
+    """signals() returns every derived figure from ONE lock acquisition
+    and each field equals its standalone property — the policy engine
+    and the telemetry report CLI must read the same numbers (ISSUE 6
+    satellite)."""
+    from gaussiank_sgd_tpu.telemetry import ThroughputSignals
+
+    tr = ThroughputTracker(window=4, ema_beta=0.5)
+    for i in range(3):
+        tr.update(32, 0.1 * (i + 1), skipped=(i == 1))
+    sig = tr.signals(flops_per_step=2e12, peak_flops=4e12)
+    assert isinstance(sig, ThroughputSignals)
+    assert sig.window_steps == len(tr) == 3
+    assert sig.skipped_in_window == tr.skipped_in_window == 1
+    assert sig.total_seconds == pytest.approx(tr.total_seconds)
+    assert sig.examples_per_s == pytest.approx(tr.examples_per_s)
+    assert sig.steps_per_s == pytest.approx(tr.steps_per_s)
+    assert sig.step_s_ema == pytest.approx(tr.step_s_ema)
+    assert sig.mfu == pytest.approx(tr.mfu(2e12, 4e12))
+    # EMA weights the recent samples (beta=0.5 over 0.1, 0.2, 0.3)
+    assert 0.1 < sig.step_s_ema < 0.3
+    # without flops context the snapshot still carries the timing fields
+    bare = tr.signals()
+    assert bare.mfu is None and bare.step_s_ema == sig.step_s_ema
+    # reset drops the EMA too: a restored run rebuilds its own trajectory
+    tr.reset()
+    assert tr.signals().step_s_ema is None
+    assert tr.signals().window_steps == 0
+
+
 # ------------------------------------------------------------------ profiler
 
 def test_profiler_session_window_and_close(monkeypatch):
