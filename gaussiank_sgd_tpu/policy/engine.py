@@ -182,6 +182,11 @@ class PolicyEngine:
         if decision.knob == KNOB_COMPRESSOR:
             self.signals.bind_arm(decision.new)
         else:
+            # a density/bucket-plan change alters the program layout, so
+            # every arm's steady-state record measured under the old
+            # layout is no longer comparable — drop them (the dense
+            # reference survives; these knobs don't touch the dense step)
+            self.signals.reset_arm_records()
             # any program rebuild invalidates in-flight timings
             self.signals.bind_arm(self._knobs.get(KNOB_COMPRESSOR))
         self._log(decision, "policy_decision", None)
@@ -238,6 +243,8 @@ class PolicyEngine:
         if revert.knob == KNOB_COMPRESSOR:
             self.signals.bind_arm(revert.new)
         else:
+            # same layout-change invalidation as note_applied
+            self.signals.reset_arm_records()
             self.signals.bind_arm(self._knobs.get(KNOB_COMPRESSOR))
         self._log(revert, "policy_revert", quarantined)
 

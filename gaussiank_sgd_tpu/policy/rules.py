@@ -185,8 +185,11 @@ class DensityRule(Rule):
                 ctx: RuleContext) -> Optional[PolicyDecision]:
         cur_s = ctx.knobs.get(KNOB_DENSITY)
         r, trend = snap.ef_grad_ratio, snap.ef_ratio_trend
+        # ef_ratio_intervals, not intervals: only sparse intervals feed
+        # the ratio, and a long dense warm-up must not pre-satisfy the
+        # floor so the first sparse samples can fire a retune
         if cur_s is None or r is None or trend is None \
-                or snap.intervals < self.min_intervals:
+                or snap.ef_ratio_intervals < self.min_intervals:
             return None
         cur = float(cur_s)
         if r > self.hi_ratio and trend > 0 and cur < self.max_density:

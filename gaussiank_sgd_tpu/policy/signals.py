@@ -32,7 +32,10 @@ class SignalSnapshot:
     ``ef_grad_ratio`` is EMA(ef_norm)/EMA(grad_norm) — the error-feedback
     pressure gauge the density rule reads (a residual norm that keeps
     growing relative to the gradient means the density is too low to
-    drain what EF accumulates); ``ef_ratio_trend`` is the difference
+    drain what EF accumulates); ``ef_ratio_intervals`` counts the sparse
+    intervals that fed it (dense warm-up intervals leave EF untouched, so
+    their ef_norm=0 is structural, not a signal — they are excluded);
+    ``ef_ratio_trend`` is the difference
     between the newest and oldest entry of the recent-ratio window
     (positive = rising). ``arm_step_s`` carries the per-selector
     steady-state EMAs observed so far — only intervals AFTER the settle
@@ -45,6 +48,7 @@ class SignalSnapshot:
     step_s_ema: Optional[float] = None
     dense_step_s_ema: Optional[float] = None
     ef_grad_ratio: Optional[float] = None
+    ef_ratio_intervals: int = 0
     ef_ratio_trend: Optional[float] = None
     achieved_density: Optional[float] = None
     bytes_per_step: Optional[float] = None
@@ -88,6 +92,7 @@ class PolicySignals:
         self._intervals = 0
         self._step_ema: Optional[float] = None
         self._ef_ratio_ema: Optional[float] = None
+        self._ef_ratio_n = 0
         self._ratio_recent: Deque[float] = deque(maxlen=max(2, trend_window))
         self._achieved: Optional[float] = None
         self._bytes: Optional[float] = None
@@ -109,6 +114,21 @@ class PolicySignals:
             self._settle_left = self._settle
             self._step_ema = None
 
+    def reset_arm_records(self) -> None:
+        """Drop every selector arm's steady-state record: after a density
+        or bucket-plan retune the program layout changed, and timings
+        measured under the old layout are not comparable with the new
+        ones (the SelectorRule's regret/exploration comparisons would mix
+        them). The DENSE_ARM reference survives — the dense step runs no
+        selection or sparse exchange, so these knobs don't move it."""
+        with self._lock:
+            dense = self._arm_ema.get(self.DENSE_ARM)
+            dense_n = self._arm_n.get(self.DENSE_ARM)
+            self._arm_ema = {} if dense is None \
+                else {self.DENSE_ARM: dense}
+            self._arm_n = {} if dense_n is None \
+                else {self.DENSE_ARM: dense_n}
+
     def _ema(self, old: Optional[float], new: float) -> float:
         return new if old is None else self._beta * old \
             + (1.0 - self._beta) * new
@@ -125,7 +145,16 @@ class PolicySignals:
                 self._consecutive_skips += 1
         elif event == "rollback":
             with self._lock:
-                self._last_rollback = int(record.get("to_step", 0) or 0)
+                to_step = int(record.get("to_step", 0) or 0)
+                self._last_rollback = to_step
+                # the rewind abandons everything past to_step: skips
+                # recorded at higher steps belong to the dead trajectory
+                # and must not count against decisions applied at lower
+                # post-rollback steps (spurious skips_after >= skip_burst
+                # would revert + quarantine a possibly good pair)
+                self._skips = {s: n for s, n in self._skips.items()
+                               if s <= to_step}
+                self._consecutive_skips = 0
 
     def _ingest_train(self, record: Mapping[str, object]) -> None:
         def num(key) -> Optional[float]:
@@ -143,9 +172,17 @@ class PolicySignals:
             if loss is not None:
                 self._loss_ema = self._ema(self._loss_ema, loss)
             ef, gn = num("ef_norm"), num("grad_norm")
-            if ef is not None and gn is not None and gn > 0:
+            if ef is not None and gn is not None and gn > 0 \
+                    and "wire_format" in record:
+                # sparse intervals only (wire_format is the same marker
+                # dense-arm attribution uses below): the dense warm-up
+                # path never touches EF, so its ef_norm=0 is structural —
+                # feeding it would drag the ratio EMA to 0 and trick the
+                # density rule into halving density before the sparse
+                # phase even starts
                 ratio = ef / gn
                 self._ef_ratio_ema = self._ema(self._ef_ratio_ema, ratio)
+                self._ef_ratio_n += 1
                 self._ratio_recent.append(ratio)
             ad = num("density_achieved")
             if ad is not None:
@@ -182,6 +219,7 @@ class PolicySignals:
                 step_s_ema=self._step_ema,
                 dense_step_s_ema=self._arm_ema.get(self.DENSE_ARM),
                 ef_grad_ratio=self._ef_ratio_ema,
+                ef_ratio_intervals=self._ef_ratio_n,
                 ef_ratio_trend=trend,
                 achieved_density=self._achieved,
                 bytes_per_step=self._bytes,

@@ -700,7 +700,12 @@ class Trainer:
                 # checkpoint meets the pre-decision program layout.
                 reason = (self.monitor.should_rollback()
                           if self.monitor is not None else None)
-                if self.engine is not None:
+                # no ticks during dense warm-up: every signal gathered so
+                # far describes the dense program (ef_norm is structurally
+                # 0, no wire/density in play), so a decision here could
+                # only misfire — and nothing can need reverting, since no
+                # decision has ever applied
+                if self.engine is not None and not self._in_warmup(done):
                     self._policy_tick(rollback_pending=reason is not None)
                 if reason:
                     self._rollback(reason)
@@ -823,7 +828,12 @@ class Trainer:
             "skipped": float(jax.device_get(m.skipped)),
             "nonfinite": float(jax.device_get(m.nonfinite)),
         }
-        if not self._in_warmup(step):
+        # ``m`` came from the step whose pre-step index is step-1, so the
+        # warm-up test must use step-1: _in_warmup(step) flips one
+        # interval early and would stamp the last all-dense interval
+        # (ef_norm structurally 0, dense allreduce bytes) as sparse —
+        # feeding the policy engine a dense sample under a sparse marker
+        if not self._in_warmup(step - 1):
             # the payload's wire format travels with every sparse bytes
             # claim (ISSUE 5 protocol: "u16bf16" packed / "i32f32"
             # legacy); warm-up steps move a dense f32 allreduce instead,

@@ -196,11 +196,60 @@ def test_signals_skips_after_and_rollback_step():
     sig = PolicySignals()
     for s in (5, 7, 12):
         sig.update({"event": "skip", "step": s, "reason": "nonfinite"})
-    sig.update({"event": "rollback", "to_step": 4, "reason": "skip_budget"})
     snap = sig.snapshot()
     assert snap.skips_after(6) == 2
     assert snap.skips_after(0) == 3
+    sig.update({"event": "rollback", "to_step": 4, "reason": "skip_budget"})
+    snap = sig.snapshot()
     assert snap.last_rollback_step == 4
+    # the rewind abandoned steps 5/7/12: their skips belong to the dead
+    # trajectory and must not satisfy a skip-burst check for a decision
+    # applied at a lower post-rollback step (it would be spuriously
+    # reverted + permanently quarantined)
+    assert snap.skips_after(0) == 0
+    assert snap.consecutive_skips == 0
+
+
+def test_signals_ef_ratio_ignores_dense_warmup_records():
+    """Dense warm-up intervals publish ef_norm=0 by construction (the
+    dense path never touches EF); the ratio EMA must only see sparse
+    intervals — otherwise the density rule reads ratio~0 through warm-up
+    and halves density to its floor before the sparse phase starts."""
+    sig = PolicySignals()
+    for step in (10, 20, 30):               # dense: no wire_format field
+        sig.update({"event": "train", "step": step, "step_s": 0.1,
+                    "ef_norm": 0.0, "grad_norm": 2.0})
+    snap = sig.snapshot()
+    assert snap.ef_grad_ratio is None
+    assert snap.ef_ratio_intervals == 0
+    assert snap.ef_ratio_trend is None
+    sig.update({"event": "train", "step": 40, "step_s": 0.1,
+                "ef_norm": 1.0, "grad_norm": 2.0,
+                "wire_format": "u16bf16"})
+    snap = sig.snapshot()
+    assert snap.ef_grad_ratio == pytest.approx(0.5)
+    assert snap.ef_ratio_intervals == 1
+
+
+def test_arm_records_reset_on_layout_change_keeps_dense_reference():
+    """A density (or bucket-plan) decision changes the program layout:
+    the engine must drop per-selector steady-state records measured under
+    the old layout (they are not comparable with post-change timings) but
+    keep the dense reference — the dense step runs no selection or sparse
+    exchange, so those knobs don't move it."""
+    rule = FlagRule(knob=KNOB_DENSITY, new="0.005", old="0.01")
+    eng = PolicyEngine([rule], hysteresis=1, cooldown=0,
+                       knobs={KNOB_COMPRESSOR: "a", KNOB_DENSITY: "0.01"},
+                       signals=PolicySignals(settle=0))
+    eng.emit({"event": "train", "step": 10, "step_s": 0.05})  # dense ref
+    feed_interval(eng, 20, step_s=0.1)       # arm "a" steady-state record
+    assert eng.signals.snapshot().arm_step_s["a"] == pytest.approx(0.1)
+    rule.on = True
+    feed_interval(eng, 30, step_s=0.1)
+    eng.note_applied(eng.decide())
+    snap = eng.signals.snapshot()
+    assert "a" not in snap.arm_step_s        # old-layout record dropped
+    assert snap.dense_step_s_ema == pytest.approx(0.05)
 
 
 # ------------------------------------------------------------------ rules
@@ -240,21 +289,23 @@ def test_selector_rule_regret_and_exploration_paths():
 def test_density_rule_ef_pressure_both_directions():
     r = DensityRule(min_density=1e-4, max_density=0.02)
     ctx = RuleContext(knobs={KNOB_DENSITY: "0.001"})
-    up = r.propose(_snap(step=10, intervals=8, ef_grad_ratio=3.0,
+    up = r.propose(_snap(step=10, ef_ratio_intervals=8, ef_grad_ratio=3.0,
                          ef_ratio_trend=0.5), ctx)
     assert up is not None and float(up.new) == pytest.approx(0.002)
-    down = r.propose(_snap(step=10, intervals=8, ef_grad_ratio=0.1,
+    down = r.propose(_snap(step=10, ef_ratio_intervals=8, ef_grad_ratio=0.1,
                            ef_ratio_trend=-0.1), ctx)
     assert down is not None and float(down.new) == pytest.approx(0.0005)
     # high ratio but NOT rising: EF is draining, hold
-    assert r.propose(_snap(step=10, intervals=8, ef_grad_ratio=3.0,
+    assert r.propose(_snap(step=10, ef_ratio_intervals=8, ef_grad_ratio=3.0,
                            ef_ratio_trend=-0.1), ctx) is None
-    # too few intervals: hold
-    assert r.propose(_snap(step=10, intervals=2, ef_grad_ratio=3.0,
-                           ef_ratio_trend=0.5), ctx) is None
+    # too few SPARSE intervals: hold, even if the run is long overall (a
+    # dense warm-up must not pre-satisfy the floor)
+    assert r.propose(_snap(step=10, intervals=100, ef_ratio_intervals=2,
+                           ef_grad_ratio=3.0, ef_ratio_trend=0.5),
+                     ctx) is None
     # clamped at the ladder top: no proposal beyond max_density
     ctx_top = RuleContext(knobs={KNOB_DENSITY: "0.02"})
-    assert r.propose(_snap(step=10, intervals=8, ef_grad_ratio=3.0,
+    assert r.propose(_snap(step=10, ef_ratio_intervals=8, ef_grad_ratio=3.0,
                            ef_ratio_trend=0.5), ctx_top) is None
 
 
@@ -291,6 +342,27 @@ def make_cfg(tmp_path, **kw):
     )
     base.update(kw)
     return TrainConfig(**base)
+
+
+def test_policy_tick_gated_during_dense_warmup(tmp_path):
+    """With compress_warmup_steps covering several log intervals, the
+    engine must stay silent until the sparse phase: every signal gathered
+    during warm-up describes the dense program (ef_norm structurally 0,
+    no wire in play), so even an eager rule must not burn recompile
+    budget before the first sparse boundary."""
+    from gaussiank_sgd_tpu.training.trainer import Trainer
+
+    t = Trainer(make_cfg(tmp_path, compress_warmup_steps=8, max_steps=10))
+    flag = FlagRule(knob=KNOB_DENSITY, new="0.005", old="0.01")
+    flag.on = True
+    t.engine.rules = [flag]
+    t.engine._hysteresis = 1
+    t.train(6)                  # boundaries at 2, 4, 6: all inside warmup
+    assert t.engine.recompiles == 0
+    assert t.cfg.density == pytest.approx(0.01)
+    t.train(2)                  # boundary at 8: warmup over -> rule fires
+    assert t.engine.recompiles == 1
+    assert t.cfg.density == pytest.approx(0.005)
 
 
 def test_adaptive_rejects_dense_only_run(tmp_path):
