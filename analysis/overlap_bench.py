@@ -1,0 +1,70 @@
+"""Post-overlap vgg16 mini-bench (ISSUE 7 satellite 1).
+
+The twin of the committed pre-overlap baseline
+(``analysis/artifacts/bench_pre_overlap_vgg16.json``): the IDENTICAL
+reduced operating point — vgg16/cifar10, batch 32, 3-step programs,
+3 rotated rounds x 2 windows — re-measured through ``bench_overlap``,
+which times the sequential (``--overlap off``) and pipelined
+(``--overlap auto``) schedules plus their exchange-ablated noexch twins
+interleaved in the same rounds. The artifact quantifies how much
+exchange time the pipeline hides: ``exposed_exchange_ms`` per schedule
+(None = below this cell's round-to-round noise floor) and the pipelined
+build's ``overlapped_bytes_sent``.
+
+The full ``python bench.py`` matrix is infeasible on this 1-core host
+(see the baseline artifact's note); the operating point is recorded in
+the artifact so the comparison is honest and reproducible.
+
+Usage: JAX_PLATFORMS=cpu python analysis/overlap_bench.py
+"""
+
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gaussiank_sgd_tpu.benchlib import bench_overlap
+from gaussiank_sgd_tpu.compressors import DEFAULT_SELECTOR
+
+BATCH, N_STEPS, ROUNDS, WINDOWS = 32, 3, 3, 2
+BUCKET_SIZE = 1 << 22
+
+times = bench_overlap("vgg16", "cifar10", BATCH, 0.001, DEFAULT_SELECTOR,
+                      n_steps=N_STEPS, rounds=ROUNDS, windows=WINDOWS,
+                      bucket_size=BUCKET_SIZE)
+meta = times["_meta"]
+assert meta["pipe_overlap"] == "pipelined", meta
+assert meta["seq_overlap"] == "off", meta
+rounds = times["_rounds"]
+pipe_vs_seq = [s / p for s, p in zip(rounds["seq"], rounds["pipe"])]
+exp = times["exposed_exchange_ms"]
+out = {
+    "note": "post-overlap vgg16 mini twin of bench_pre_overlap_vgg16.json "
+            "(identical reduced operating point; seq/pipe + noexch twins "
+            "interleaved in the same rotated rounds)",
+    "model": "vgg16", "dataset": "cifar10", "batch": BATCH,
+    "n_steps": N_STEPS, "rounds": ROUNDS, "windows": WINDOWS,
+    "compressor": DEFAULT_SELECTOR, "bucket_size": BUCKET_SIZE,
+    "n_buckets": meta["n_buckets"],
+    "seq_step_ms": round(1e3 * times["seq"], 3),
+    "pipe_step_ms": round(1e3 * times["pipe"], 3),
+    "seq_noexch_step_ms": round(1e3 * times["seq_noexch"], 3),
+    "pipe_noexch_step_ms": round(1e3 * times["pipe_noexch"], 3),
+    "pipe_vs_seq_median": round(statistics.median(pipe_vs_seq), 4),
+    "pipe_vs_seq_rounds": [round(r, 4) for r in pipe_vs_seq],
+    "exposed_seq_ms": exp["seq"],
+    "exposed_pipe_ms": exp["pipe"],
+    "seq_overlap": meta["seq_overlap"],
+    "pipe_overlap": meta["pipe_overlap"],
+    "wire_format": meta["wire_format"],
+    "bytes_sent": meta["pipe_bytes_sent"],
+    "overlapped_bytes_sent": meta["overlapped_bytes_sent"],
+    "platform": "cpu", "n_devices_host": 1,
+}
+dest = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "artifacts", "bench_post_overlap_vgg16.json")
+with open(dest, "w") as f:
+    json.dump(out, f, indent=2)
+print(json.dumps(out))

@@ -119,7 +119,7 @@ def _load_roofline(artifacts: str):
 
 def main(argv: Optional[List[str]] = None):
     from gaussiank_sgd_tpu import virtual_cpu
-    from gaussiank_sgd_tpu.benchlib import bench_model, mfu
+    from gaussiank_sgd_tpu.benchlib import bench_model, bench_overlap, mfu
 
     # default [] (not sys.argv): the test harness calls main() inside a
     # pytest process whose argv is pytest's, not ours
@@ -127,6 +127,14 @@ def main(argv: Optional[List[str]] = None):
     ap.add_argument("--smoke", action="store_true",
                     help="tiny single-config run for CI: exercises the "
                          "harness + telemetry emission, not a real number")
+    ap.add_argument("--configs", nargs="*", default=None,
+                    help="subset of config keys to run (default: all; "
+                         "feasibility valve for small hosts — the "
+                         "artifact records which configs ran)")
+    ap.add_argument("--overlap-arm", action="store_true",
+                    help="also time each config's off-vs-auto schedule "
+                         "pair on a pipeline-eligible uniform plan "
+                         "(ISSUE 7; always on under --smoke)")
     args = ap.parse_args([] if argv is None else argv)
 
     # persistent compile cache: repeated driver runs skip the multi-minute
@@ -151,6 +159,8 @@ def main(argv: Optional[List[str]] = None):
     floors = _load_roofline(artifacts)
     configs = SMOKE_CONFIGS if args.smoke else CONFIGS
     for key, model, dataset, batch, n_steps, rounds in configs:
+        if args.configs and key not in args.configs:
+            continue
         # the flagship config also runs the 3-selector sweep (secondary
         # winner field); the other configs run the fixed selector only to
         # bound driver wall-clock
@@ -181,6 +191,10 @@ def main(argv: Optional[List[str]] = None):
         ex = times.get("_exchange", {}).get(FIXED, {})
         cell["wire_format"] = ex.get("wire_format")
         cell["bytes_sent"] = ex.get("bytes_sent")
+        # which step schedule the main sparse arm compiled to (ISSUE 7:
+        # the greedy contract plan is pipeline-ineligible, so this stays
+        # "off" unless the plan is uniform multi-chunk)
+        cell["overlap"] = ex.get("overlap")
         if key in floors:
             cell["roofline_floor_ms"] = floors[key]
             cell["overhead_vs_floor"] = (
@@ -214,7 +228,8 @@ def main(argv: Optional[List[str]] = None):
                  roofline_floor_ms=cell.get("roofline_floor_ms"),
                  overhead_vs_floor=cell.get("overhead_vs_floor"),
                  wire_format=cell["wire_format"],
-                 bytes_sent=cell["bytes_sent"])
+                 bytes_sent=cell["bytes_sent"],
+                 overlap=cell["overlap"])
         print(f"# {key}: window_min {cell['ratio_window_min']} "
               f"median {cell['ratio_median']} "
               f"min {cell['ratio_min']} mfu_dense {cell['mfu_dense']}",
@@ -233,6 +248,54 @@ def main(argv: Optional[List[str]] = None):
                     f"{ex.get('wire_format')!r}, bytes_sent="
                     f"{ex.get('bytes_sent')} vs fp32+i32 {fp32_bytes} "
                     f"(need u16bf16 and <= 0.55x)")
+
+        if args.overlap_arm or args.smoke:
+            # ISSUE-7 overlap arm: the same model/selector under both
+            # step schedules on one pipeline-eligible uniform plan, each
+            # with its exchange-ablated twin, all in the same rotated
+            # rounds (benchlib.bench_overlap) — the per-config measured
+            # answer to "how much exchange time does the pipeline hide"
+            ob = bench_overlap(
+                model, dataset, batch, density, FIXED,
+                n_steps=n_steps, rounds=rounds, windows=WINDOWS,
+                bucket_size=(SMOKE_BUCKETS["bucket_size"] if args.smoke
+                             else 1 << 22))
+            om, oe = ob["_meta"], ob["exposed_exchange_ms"]
+            arm = {
+                "seq_step_ms": round(1e3 * ob["seq"], 3),
+                "pipe_step_ms": round(1e3 * ob["pipe"], 3),
+                "pipe_vs_seq": round(ob["seq"] / ob["pipe"], 4),
+                "exposed_seq_ms": oe["seq"],
+                "exposed_pipe_ms": oe["pipe"],
+                "seq_overlap": om["seq_overlap"],
+                "pipe_overlap": om["pipe_overlap"],
+                "bucket_size": om["bucket_size"],
+                "n_buckets": om["n_buckets"],
+                "wire_format": om.get("wire_format"),
+                "bytes_sent": om.get("pipe_bytes_sent"),
+                "overlapped_bytes_sent": om.get("overlapped_bytes_sent"),
+            }
+            cell["overlap_arm"] = arm
+            bus.emit("bench_overlap", key=key, model=model,
+                     compressor=FIXED, rounds=rounds, windows=WINDOWS,
+                     **{k: v for k, v in arm.items() if v is not None})
+            print(f"# {key} overlap arm: seq {arm['seq_step_ms']} ms "
+                  f"(exposed {arm['exposed_seq_ms']}) vs pipe "
+                  f"{arm['pipe_step_ms']} ms (exposed "
+                  f"{arm['exposed_pipe_ms']}), x{arm['pipe_vs_seq']}",
+                  flush=True)
+            if args.smoke and (arm["pipe_overlap"] != "pipelined"
+                               or arm["seq_overlap"] != "off"
+                               or not arm["overlapped_bytes_sent"]):
+                # CI acceptance (ISSUE 7): the smoke plan is pipeline-
+                # eligible by construction, so the 'auto' build must have
+                # compiled the pipelined schedule and launched payload
+                # bytes from inside the scan body
+                raise ValueError(
+                    f"smoke overlap gate failed: seq_overlap="
+                    f"{arm['seq_overlap']!r}, pipe_overlap="
+                    f"{arm['pipe_overlap']!r}, overlapped_bytes_sent="
+                    f"{arm['overlapped_bytes_sent']}")
 
     # The contract is "EVERY config >= 0.90" (BASELINE.json metric), so the
     # reportable scalar is the MIN over config binding ratios — and each
@@ -301,6 +364,16 @@ def main(argv: Optional[List[str]] = None):
                                   for k, c in detail_configs.items()
                                   if c.get("overhead_vs_floor")
                                   is not None} or None,
+            # overlap arm (ISSUE 7), configs that ran it: measured
+            # exposed exchange under each schedule (None = below noise)
+            "overlap_arm": {k: {"exposed_seq_ms":
+                                c["overlap_arm"]["exposed_seq_ms"],
+                                "exposed_pipe_ms":
+                                c["overlap_arm"]["exposed_pipe_ms"],
+                                "pipe_vs_seq":
+                                c["overlap_arm"]["pipe_vs_seq"]}
+                            for k, c in detail_configs.items()
+                            if "overlap_arm" in c} or None,
             "platform": jax.devices()[0].platform,
             "full_detail": "analysis/artifacts/bench_last.json",
         },

@@ -201,6 +201,34 @@ def _run_once(multi_step, mk_state, batch, n_steps):
     return (time.perf_counter() - t0) / n_steps
 
 
+def _time_programs(programs, batch, n_steps, rounds, windows):
+    """Interleaved rotated-round timing over a dict of
+    ``name -> (multi_step, mk_state)`` programs (the shared inner loop of
+    ``bench_model`` and ``bench_overlap``). Returns ``(min_times,
+    round_times, window_times)`` — per-variant min seconds, pooled
+    per-round samples, and the same samples grouped per window."""
+    out = {k: float("inf") for k in programs}
+    round_times = {k: [] for k in programs}
+    window_times = {k: [] for k in programs}
+    names = list(programs)
+    for w in range(max(1, int(windows))):
+        wt = {k: [] for k in programs}
+        for r in range(rounds):
+            # rotate the within-round order (continuously across windows)
+            # — a fixed order hands whatever first-slot penalty exists to
+            # the same variant every round
+            g = w * rounds + r
+            for name in names[g % len(names):] + names[:g % len(names)]:
+                fn, mk = programs[name]
+                t = _run_once(fn, mk, batch, n_steps)
+                wt[name].append(t)
+                round_times[name].append(t)
+                out[name] = min(out[name], t)
+        for k in programs:
+            window_times[k].append(wt[k])
+    return out, round_times, window_times
+
+
 def bench_model(model: str, dataset: str, batch_size: int, density: float,
                 compressors: Sequence[str], n_steps: int, rounds: int = 8,
                 windows: int = 1,
@@ -277,6 +305,7 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
             dense_ts, dense_mk = ts, mk
         programs[name] = (ts.make_multi_step("sparse", n_steps), mk)
         exchange_meta[name] = {"wire_format": ts.wire_format,
+                               "overlap": ts.overlap,
                                "total_k": int(ts.plan.total_k)}
 
     for name, (fn, mk) in programs.items():   # compile + warm
@@ -287,25 +316,8 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
             # warm run — the jitted step counts its own concrete buffers
             exchange_meta[name]["bytes_sent"] = int(m.bytes_sent)
 
-    out = {k: float("inf") for k in programs}
-    round_times = {k: [] for k in programs}
-    window_times = {k: [] for k in programs}
-    names = list(programs)
-    for w in range(max(1, int(windows))):
-        wt = {k: [] for k in programs}
-        for r in range(rounds):
-            # rotate the within-round order (continuously across windows)
-            # — a fixed order hands whatever first-slot penalty exists to
-            # the same variant every round
-            g = w * rounds + r
-            for name in names[g % len(names):] + names[:g % len(names)]:
-                fn, mk = programs[name]
-                t = _run_once(fn, mk, batch, n_steps)
-                wt[name].append(t)
-                round_times[name].append(t)
-                out[name] = min(out[name], t)
-        for k in programs:
-            window_times[k].append(wt[k])
+    out, round_times, window_times = _time_programs(
+        programs, batch, n_steps, rounds, windows)
     # per-round samples for median/dispersion reporting (VERDICT r2 item 6:
     # min-of-rounds alone lets drift-band artifacts carry a headline), plus
     # the same samples grouped per window (min-across-window-medians
@@ -322,3 +334,88 @@ def bench_model(model: str, dataset: str, batch_size: int, density: float,
             dense_ts.dense_step, dense_mk(), batch)
         out["_peak_flops"] = device_peak_flops()
     return out
+
+
+def bench_overlap(model: str, dataset: str, batch_size: int,
+                  density: float, compressor: str, n_steps: int,
+                  rounds: int = 4, windows: int = 1,
+                  bucket_size: int = 1 << 22,
+                  model_kwargs: Optional[dict] = None,
+                  dtype=jnp.bfloat16) -> Dict[str, object]:
+    """The ISSUE-7 overlap arm: the SAME model/selector timed under both
+    step schedules on one pipeline-eligible uniform bucket plan, each
+    with its exchange-ablated timing twin, all four programs interleaved
+    in the same rotated rounds so the off-vs-auto comparison and both
+    ``exposed_exchange_ms`` estimates are drift-cancelled.
+
+    Timing keys: ``seq``/``seq_noexch`` (overlap='off') and
+    ``pipe``/``pipe_noexch`` (overlap='auto'). ``exposed_exchange_ms``
+    per schedule = ``noise_floored_delta_ms`` of the variant against its
+    twin (None = below this cell's round-to-round noise). ``_meta``
+    carries the builds' reported schedules (the 'auto' build must say
+    'pipelined' — callers assert eligibility), wire format, per-step
+    bytes and the pipelined build's ``overlapped_bytes_sent``."""
+    from .compressors import get_compressor
+    from .models import get_model
+    from .parallel.bucketing import plan_for_params
+    from .parallel.flat_opt import FlatSGDM
+    from .parallel.mesh import data_parallel_mesh, shard_batch
+    from .parallel.trainstep import build_dp_train_step
+    from .training.losses import make_loss_fn
+
+    mesh = data_parallel_mesh()
+    spec = get_model(model, dataset, dtype=dtype, **(model_kwargs or {}))
+    rng = jax.random.PRNGKey(0)
+    x, y = make_batch(spec, batch_size)
+    recurrent = model == "lstm"
+    init_inputs = ((x[:2], y[:2]) if spec.task == "seq2seq" else (x[:2],))
+    variables = spec.module.init({"params": rng}, *init_inputs, train=False)
+    params = variables["params"]
+    mstate = {k: v for k, v in variables.items() if k != "params"}
+    plan = plan_for_params(params, density, bucket_size, policy="uniform")
+    batch = shard_batch(mesh, (x, y))
+    carry = (spec.module.initial_carry(batch_size) if recurrent else ())
+    loss_fn = make_loss_fn(spec, recurrent=recurrent)
+
+    programs = {}
+    meta: Dict[str, object] = {"bucket_size": bucket_size,
+                               "n_buckets": len(plan.buckets),
+                               "total_k": int(plan.total_k)}
+    for arm, overlap in (("seq", "off"), ("pipe", "auto")):
+        comp = get_compressor(compressor, density=density)
+        ts = build_dp_train_step(
+            loss_fn, None, comp, plan, mesh, recurrent=recurrent,
+            flat_opt=FlatSGDM(lr=0.1, momentum=0.9), overlap=overlap)
+        meta[f"{arm}_overlap"] = ts.overlap
+        meta.setdefault("wire_format", ts.wire_format)
+
+        def mk(ts=ts):
+            return ts.init_state(params, jax.random.PRNGKey(2),
+                                 model_state=mstate, carry=carry)
+
+        programs[arm] = (ts.make_multi_step("sparse", n_steps), mk)
+        programs[f"{arm}_noexch"] = (
+            ts.make_multi_step("sparse_noexch", n_steps), mk)
+
+    for arm in ("seq", "pipe"):                # compile + warm, drain meta
+        fn, mk = programs[arm]
+        st, m = fn(mk(), batch)
+        _ = float(m.loss)
+        meta[f"{arm}_bytes_sent"] = int(m.bytes_sent)
+        if arm == "pipe":
+            meta["overlapped_bytes_sent"] = int(m.overlapped_bytes_sent)
+        fn_nx, mk_nx = programs[f"{arm}_noexch"]
+        st, m = fn_nx(mk_nx(), batch)
+        _ = float(m.loss)
+
+    out, round_times, window_times = _time_programs(
+        programs, batch, n_steps, rounds, windows)
+    result: Dict[str, object] = {k: out[k] for k in programs}
+    result["_rounds"] = round_times
+    result["_windows"] = window_times
+    result["_meta"] = meta
+    result["exposed_exchange_ms"] = {
+        "seq": noise_floored_delta_ms(round_times, "seq", "seq_noexch"),
+        "pipe": noise_floored_delta_ms(round_times, "pipe", "pipe_noexch"),
+    }
+    return result

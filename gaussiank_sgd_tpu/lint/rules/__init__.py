@@ -11,7 +11,7 @@ import os
 from typing import List, Optional, Sequence, Set
 
 from . import (control_flow, donation, fail_loud, host_sync, mesh_axes,
-               print_in_library, recompile)
+               pipeline_funnel, print_in_library, recompile)
 
 ALL_RULES = [
     host_sync.Rule(),
@@ -21,6 +21,7 @@ ALL_RULES = [
     control_flow.Rule(),
     fail_loud.Rule(),
     print_in_library.Rule(),
+    pipeline_funnel.Rule(),
 ]
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

@@ -51,6 +51,12 @@ class GtopkCommStats(NamedTuple):
                              # concrete per-round buffer's entry count:
                              # (idx, val) pairs legacy, u32 words packed)
     wire_format: str = wire_mod.WIRE_LEGACY  # format of the round payloads
+    overlapped_bytes: int = 0  # bytes of the above issued INSIDE the
+                             # bucket-pipelined scan body (round-1 chunks
+                             # whose ppermute XLA can latency-hide behind
+                             # the next chunk's compress); 0 sequential
+    pipelined: bool = False  # True when round 1 ran per-chunk inside the
+                             # pipelined step (trainstep.py overlap gate)
 
 
 def merge_sparse(idx_a: jax.Array, val_a: jax.Array, idx_b: jax.Array,
@@ -79,8 +85,63 @@ def merge_sparse(idx_a: jax.Array, val_a: jax.Array, idx_b: jax.Array,
     return seg_idx[top].astype(jnp.int32), summed[top]
 
 
+def butterfly_rounds(idx: jax.Array, val: jax.Array, num_devices: int,
+                     axis_name: str,
+                     wire: Optional[wire_mod.WireFormat] = None,
+                     start_round: int = 0, ablate_comm: bool = False,
+                     ) -> Tuple[jax.Array, jax.Array, int]:
+    """Rounds ``start_round .. log2(P)-1`` of the XOR butterfly over an
+    already-merged k-entry sparse set; returns ``(idx, val, bytes_sent)``.
+
+    This is the single issue point for the gtopk path's ``lax.ppermute``
+    (the gklint collective-outside-pipeline funnel): ``gtopk_allreduce``
+    delegates to it with ``start_round=0`` (op-identical to the historical
+    inline loop), and the bucket-pipelined step (trainstep.py) runs round
+    0 per-chunk inside its scan and hands the merged buffers here with
+    ``start_round=1`` for the remaining hops.
+
+    ``ablate_comm`` replaces each ppermute with the identity — the
+    'sparse_noexch' timing twin used to measure EXPOSED exchange time
+    (every compute op, byte count, and merge still runs; only the wire
+    hop is elided). Never used by a training program.
+    """
+    p = num_devices
+    assert p & (p - 1) == 0, f"gtopk needs power-of-2 workers, got {p}"
+    k = idx.shape[0]
+    bytes_sent = 0
+    n_rounds = int(math.log2(p))
+    for r in range(start_round, n_rounds):
+        stride = 1 << r
+        perm = [(j, j ^ stride) for j in range(p)]
+        if wire is not None:
+            # wire precision BEFORE the merge: the local copy must equal
+            # what the partner decodes, or the two sides of the butterfly
+            # would merge different values and diverge
+            val = wire_mod.bf16_roundtrip(val)
+            words, counts = wire_mod.encode_sorted(idx, val, wire)
+            bytes_sent += (words.size * words.dtype.itemsize
+                           + counts.size * counts.dtype.itemsize)
+            if ablate_comm:
+                o_words, o_counts = words, counts
+            else:
+                o_words = lax.ppermute(words, axis_name, perm)
+                o_counts = lax.ppermute(counts, axis_name, perm)
+            o_idx, o_val = wire_mod.decode_sorted(o_words, o_counts, wire)
+        else:
+            bytes_sent += (idx.size * idx.dtype.itemsize
+                           + val.size * val.dtype.itemsize)
+            if ablate_comm:
+                o_idx, o_val = idx, val
+            else:
+                o_idx = lax.ppermute(idx, axis_name, perm)
+                o_val = lax.ppermute(val, axis_name, perm)
+        idx, val = merge_sparse(idx, val, o_idx, o_val, k)
+    return idx, val, bytes_sent
+
+
 def gtopk_allreduce(comp: CompressedGrad, num_devices: int, axis_name: str,
                     wire: Optional[wire_mod.WireFormat] = None,
+                    ablate_comm: bool = False,
                     ) -> Tuple[CompressedGrad, GtopkCommStats]:
     """Butterfly gTop-k: log2(P) ppermute rounds; result identical on every
     worker (the global top-k of the summed sparse gradients, k entries).
@@ -95,6 +156,9 @@ def gtopk_allreduce(comp: CompressedGrad, num_devices: int, axis_name: str,
     count (ADVICE r3). ``rounds``/``entries_per_round`` feed the telemetry
     stream's comms accounting (docs/OBSERVABILITY.md).
 
+    ``ablate_comm``: identity in place of every ppermute — the noexch
+    timing twin (see ``butterfly_rounds``); never a training program.
+
     ``wire``: an active ``parallel/wire.py`` format packs each round's
     payload as u32 words (sorted by global index + an ``int32[n_buckets]``
     count vector — ``encode_sorted``) instead of (i32, f32) pairs. The
@@ -104,34 +168,13 @@ def gtopk_allreduce(comp: CompressedGrad, num_devices: int, axis_name: str,
     converges to the same global top-k bit-for-bit (2-element segment
     sums are commutative). ``wire=None`` is the legacy path, unchanged.
     """
-    p = num_devices
-    assert p & (p - 1) == 0, f"gtopk needs power-of-2 workers, got {p}"
     k = comp.indices.shape[0]
-    idx, val = comp.indices, comp.values
-    bytes_sent = 0
-    n_rounds = int(math.log2(p))
-    for r in range(n_rounds):
-        stride = 1 << r
-        perm = [(j, j ^ stride) for j in range(p)]
-        if wire is not None:
-            # wire precision BEFORE the merge: the local copy must equal
-            # what the partner decodes, or the two sides of the butterfly
-            # would merge different values and diverge
-            val = wire_mod.bf16_roundtrip(val)
-            words, counts = wire_mod.encode_sorted(idx, val, wire)
-            bytes_sent += (words.size * words.dtype.itemsize
-                           + counts.size * counts.dtype.itemsize)
-            o_words = lax.ppermute(words, axis_name, perm)
-            o_counts = lax.ppermute(counts, axis_name, perm)
-            o_idx, o_val = wire_mod.decode_sorted(o_words, o_counts, wire)
-        else:
-            bytes_sent += (idx.size * idx.dtype.itemsize
-                           + val.size * val.dtype.itemsize)
-            o_idx = lax.ppermute(idx, axis_name, perm)
-            o_val = lax.ppermute(val, axis_name, perm)
-        idx, val = merge_sparse(idx, val, o_idx, o_val, k)
+    idx, val, bytes_sent = butterfly_rounds(
+        comp.indices, comp.values, num_devices, axis_name, wire,
+        start_round=0, ablate_comm=ablate_comm)
     stats = GtopkCommStats(
-        bytes_sent=bytes_sent, rounds=n_rounds, entries_per_round=k,
+        bytes_sent=bytes_sent, rounds=int(math.log2(num_devices)),
+        entries_per_round=k,
         wire_format=wire.name if wire is not None else wire_mod.WIRE_LEGACY)
     return CompressedGrad(idx, val), stats
 

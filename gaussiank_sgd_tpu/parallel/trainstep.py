@@ -28,6 +28,7 @@ carries no warm-up branching.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -131,6 +132,14 @@ class StepMetrics(NamedTuple):
     sel_per_bucket: jax.Array  # float32[n_buckets]: dp-mean per-bucket
                               # selection counts — the per-bucket comms
                               # breakdown (dense path: bucket sizes)
+    overlapped_bytes_sent: jax.Array  # float32: the subset of bytes_sent
+                              # issued INSIDE the bucket-pipelined scan
+                              # body, where XLA can latency-hide the
+                              # collective behind the next chunk's
+                              # compress (docs/PERFORMANCE.md pipeline
+                              # section). 0 on the sequential program and
+                              # the dense path. Trace-time static, f32
+                              # for the same wrap-safety as bytes_sent.
 
 
 # loss_fn(params, model_state, batch, rng)
@@ -230,6 +239,18 @@ def _clip_by_global_norm(flat_g: jax.Array, clip: Optional[float]):
     return flat_g * scale
 
 
+def _compressor_call(spec: CompressorSpec, chunk: jax.Array, k: int,
+                     st: jax.Array, rg: jax.Array):
+    """Uniform compressor-call convention: unused st/rg pass through so ONE
+    code path serves all four (stateful x requires_rng) cases — shared by
+    ``compress_buckets`` (vmapped and unrolled) and the bucket-pipelined
+    step's per-chunk compress, which MUST route through the exact same
+    machinery for bit-parity with the sequential program."""
+    args = (chunk, k) + ((st,) if spec.stateful else ())
+    r = spec.fn(*args, rg) if spec.requires_rng else spec.fn(*args)
+    return r if spec.stateful else (r, st)
+
+
 def compress_buckets(spec: CompressorSpec, plan: BucketPlan, acc: jax.Array,
                      rng: jax.Array, comp_state: Any = (),
                      ) -> Tuple[CompressedGrad, jax.Array, jax.Array, Any]:
@@ -253,13 +274,7 @@ def compress_buckets(spec: CompressorSpec, plan: BucketPlan, acc: jax.Array,
     see the zeros — same class of approximation as the reference's fused
     buckets mixing tensors.
     """
-    def call(chunk, k, st, rg):
-        """Uniform compressor-call convention: unused st/rg pass through so
-        ONE code path serves all four (stateful x requires_rng) cases, for
-        both the vmapped and the unrolled branch below."""
-        args = (chunk, k) + ((st,) if spec.stateful else ())
-        r = spec.fn(*args, rg) if spec.requires_rng else spec.fn(*args)
-        return r if spec.stateful else (r, st)
+    call = functools.partial(_compressor_call, spec)
 
     if plan.uniform and len(plan.buckets) > 1:
         n_chunks = len(plan.buckets)
@@ -339,6 +354,12 @@ class DPTrainStep(NamedTuple):
     # "i32f32" otherwise (legacy, bit-identical to the pre-wire program).
     # Telemetry/bench report it next to every bytes_sent claim.
     wire_format: str = wire_mod.WIRE_LEGACY
+    # "pipelined" when this build's sparse step runs the bucket-pipelined
+    # schedule (per-chunk EF+select with the collective for chunk i issued
+    # while chunk i+1 compresses — the double-buffered lax.scan), "off"
+    # when it runs the historical sequential program (--overlap off or an
+    # ineligible plan). Telemetry/bench report it next to every timing.
+    overlap: str = "off"
 
 
 def build_dp_train_step(
@@ -359,6 +380,7 @@ def build_dp_train_step(
     guard_nonfinite: bool = True,
     decorrelate_comp_rng: bool = False,
     wire: str = "auto",
+    overlap: str = "auto",
 ) -> DPTrainStep:
     """Build the data-parallel train step over ``mesh``.
 
@@ -423,6 +445,24 @@ def build_dp_train_step(
     ``'off'`` — or an ineligible build — keeps the legacy format with a
     program bit-identical to the pre-wire one. ``DPTrainStep.wire_format``
     reports which format the build actually uses.
+
+    ``overlap``: ``'auto'`` (default) builds the BUCKET-PIPELINED sparse
+    step when the plan is eligible: a uniform plan with >= 2 buckets (and,
+    on gtopk, a gather axis of >= 2 workers). The pipelined program is a
+    two-phase ``lax.scan`` over the uniform chunks — a prologue compresses
+    chunk 0, then each scan iteration ISSUES the collective for chunk i's
+    payload while compressing chunk i+1, with an epilogue collective for
+    the last chunk — double-buffered so XLA can latency-hide each hop
+    behind the next chunk's EF+select compute (the reference lineage's
+    per-bucket comm/compute overlap, SURVEY.md §2 C2, rebuilt inside one
+    SPMD program). Every per-chunk compress routes through the SAME
+    batched compressor machinery as the sequential step (1-row batches)
+    and the gathered chunks reassemble into the exact sequential buffer
+    layout, so the pipelined step is bit-identical to the sequential one
+    end to end (tests/test_overlap.py N-step parity). ``'off'`` — or an
+    ineligible build — keeps the sequential program bit-identical to
+    before this knob existed. ``DPTrainStep.overlap`` reports which
+    schedule the build actually uses.
     """
     axes = tuple(mesh.axis_names)
     if sp_axis is not None:
@@ -501,6 +541,19 @@ def build_dp_train_step(
     # exchange, program bit-identical to the pre-wire build
     wire_fmt = (wire_mod.plan_wire_format(plan, grad_dtype)
                 if wire == "auto" else None)
+
+    if overlap not in ("auto", "off"):
+        raise ValueError(
+            f"unknown overlap {overlap!r}; expected 'auto' or 'off'")
+    gather_size = mesh.shape[gather_axis]
+    # build-time overlap gate: the pipelined scan needs the uniform-chunk
+    # geometry (per-chunk payloads are fixed [k]-shaped and chunk-major
+    # reassembly reconstructs the sequential buffer exactly); a single
+    # bucket has nothing to overlap, and the gtopk round-1 ppermute needs
+    # a partner. Ineligible builds keep the sequential program.
+    pipelined = (overlap == "auto" and plan.uniform
+                 and len(plan.buckets) >= 2
+                 and (exchange != "gtopk" or gather_size >= 2))
 
     def _all_axes_size():
         p = 1
@@ -693,114 +746,338 @@ def build_dp_train_step(
             state.comp_state[0] if spec.stateful else ())
         return comp, residual, nsel, cstate, acc, None
 
-    def sparse_step_fn(state: TrainState, batch: Any):
-        data_rng, comp_rng = _step_rngs(state)
-        loss, mstate, aux, new_carry, flat_g, unravel = _local_grads(
-            state, batch, data_rng, ef_numel - n_total)
-        scale = fold_lr(state.step) if fold_lr is not None else 1.0
-        comp, residual, nsel, cstate, acc, words = _compress_phase(
-            state, flat_g, scale, comp_rng)
-        k_packed = comp.indices.shape[0]
+    def _make_sparse_step(use_pipeline: bool, ablate: bool):
+        """Build one sparse step program.
 
-        if exchange == "gtopk":
-            # butterfly gTop-k: k entries per round, log2(P) rounds; the
-            # global top-k is identical on every worker (gtopk.py). EF keeps
-            # everything not globally selected.
-            from .gtopk import global_residual, gtopk_allreduce
-            # trace-time count of the buffers actually ppermuted (shape x
-            # itemsize per butterfly round) — measured, not a formula
-            gcomp, comm = gtopk_allreduce(comp, mesh.size, gather_axis,
-                                          wire=wire_fmt)
-            # the /P average rides the k-sized VALUES, not the n-sized
-            # dense buffer: one full read+write pass saved (r4 floor work)
-            gcomp = gcomp._replace(values=gcomp.values / _all_axes_size())
-            if flat_opt is None:
-                dense = decompress(gcomp, n_total, grad_dtype)
-            residual = global_residual(acc, gcomp)
-            bytes_sent = jnp.float32(comm.bytes_sent)
-        elif wire_fmt is not None:
-            # packed wire exchange (parallel/wire.py): ONE all-gather of
-            # u32 words — u16 bucket-relative index | bf16 value, half the
-            # (i32, f32) payload. The receiver reconstructs global indices
-            # from (position-derived bucket id, relative offset); no i32
-            # index buffer is gathered or materialized on the wire.
-            if words is None:     # unfused path: encode from the global comp
-                words = wire_mod.encode_grouped(comp, wire_fmt)
-            g_words = lax.all_gather(words, gather_axis, tiled=True)
-            g_comp = wire_mod.decode_grouped(g_words, wire_fmt, k_packed)
-            g_idx = g_comp.indices
-            g_val = g_comp.values / _all_axes_size()
-            if flat_opt is None:
-                dense = decompress(CompressedGrad(g_idx, g_val), n_total,
-                                   grad_dtype)
-                for a in outer_axes:
-                    dense = lax.psum(dense, a)
-            # EF absorbs the bf16 rounding on-device in f32: the committed
-            # residual gets back exactly (value - decoded value) at each
-            # sent index, so the quantization error never accumulates.
-            # mode='drop' for pad-chunk slots at/above the residual length.
-            q_err = comp.values - wire_mod.bf16_roundtrip(comp.values)
-            residual = residual.at[comp.indices].add(q_err, mode="drop")
-            # measured from the concrete packed buffer handed to the
-            # collective — 4 bytes/entry, never a closed-form estimate
-            bytes_sent = jnp.float32(words.size * words.dtype.itemsize)
-        else:
-            # ONE all-gather of the packed pairs over the (ICI) gather axis,
-            # scatter-summed dense; hierarchical meshes psum the dense
-            # partial across the outer (DCN) axes (collectives.py). The /P
-            # average is applied to the k-sized gathered values BEFORE the
-            # scatter — dividing the n-sized dense buffer costs a full
-            # read+write pass; each outer-axis partial is already /P-scaled
-            # so the psum-summed result is identical.
-            g_idx = lax.all_gather(comp.indices, gather_axis, tiled=True)
-            g_val = lax.all_gather(comp.values, gather_axis,
-                                   tiled=True) / _all_axes_size()
-            if flat_opt is None:
-                dense = decompress(CompressedGrad(g_idx, g_val), n_total,
-                                   grad_dtype)
-                for a in outer_axes:
-                    dense = lax.psum(dense, a)
-            # measured from the concrete (idx, val) buffers handed to the
-            # collectives (same count the old closed form produced)
-            bytes_sent = jnp.float32(
-                comp.indices.size * comp.indices.dtype.itemsize
-                + comp.values.size * comp.values.dtype.itemsize)
+        ``use_pipeline`` selects the bucket-pipelined schedule (the
+        double-buffered lax.scan — see the ``overlap`` docstring) vs. the
+        historical sequential program; both are bit-identical in output.
 
-        if flat_opt is not None:
-            # scatter the gathered pairs straight into the decayed momentum
-            # (flat_opt.py): no dense gradient buffer exists on this path
+        ``ablate`` builds the 'sparse_noexch' TIMING TWIN: every compute
+        op, reassembly, byte count, and metric collective stays, but the
+        exchange collectives (all_gather / ppermute of the payload, the
+        outer-axis dense psum) become local identities of the same shape.
+        step_time(sparse) - step_time(noexch) is therefore the EXPOSED
+        exchange time — the part XLA failed to hide behind compute. The
+        twin's numerics are garbage by construction (every worker sees
+        only its own payload); it never trains, only times.
+        """
+
+        def _gather(x):
+            """Single issue point for the allgather-path payload collective
+            (gklint collective-outside-pipeline funnel)."""
+            if ablate:
+                return jnp.tile(x, gather_size)
+            return lax.all_gather(x, gather_axis, tiled=True)
+
+        def _psum_outer(x):
+            if ablate:
+                return x
+            for a in outer_axes:
+                x = lax.psum(x, a)
+            return x
+
+        def _pipeline_launch(payload):
+            """Issue the collective for ONE chunk's payload — called from
+            the scan body for chunks 0..n-2 (overlapped behind the next
+            chunk's compress) and once from the epilogue for the last
+            chunk. gtopk launches its round-1 (stride 1) ppermute here;
+            the remaining log2(P)-1 rounds need the merged buffer and run
+            post-scan via butterfly_rounds."""
             if exchange == "gtopk":
-                g_idx, g_val = gcomp.indices, gcomp.values
-            upd, m_new = flat_opt.sparse_step(
-                state.opt_state["m"], g_idx.reshape(-1), g_val,
-                _flat_params_if_wd(state), state.step)
-            new_state = _apply_flat(state, mstate, upd, m_new, unravel,
-                                    residual, new_carry,
-                                    cstate[None, :] if spec.stateful else ())
-        else:
-            new_state = _apply(state, mstate, dense, unravel, residual,
-                               new_carry,
-                               cstate[None, :] if spec.stateful else ())
-        if guard_nonfinite:
-            cnt = _guard_count(loss, flat_g)
-            new_state = _guard_commit(cnt == 0, state, new_state)
-            skipped = (cnt > 0).astype(jnp.float32)
-            nonfinite = cnt.astype(jnp.float32)
-        else:
-            skipped = nonfinite = jnp.float32(0)
-        # on-device comms/compression accounting (telemetry): one pmean of
-        # the per-bucket count vector serves num_selected, the achieved
-        # density, AND the per-bucket breakdown; the EF norm reads the
-        # COMMITTED residual so a guard-skipped step reports the state
-        # that actually persists
-        sel_per_bucket = _pmean(nsel.astype(jnp.float32))
-        num_selected = jnp.sum(sel_per_bucket)
-        return new_state, StepMetrics(
-            loss, aux, _pmean(jnp.linalg.norm(flat_g)),
-            num_selected, bytes_sent, skipped, nonfinite,
-            achieved_density=num_selected / n_total,
-            ef_norm=_ef_norm(new_state.ef_residual),
-            sel_per_bucket=sel_per_bucket)
+                if ablate:
+                    return payload
+                perm = [(j, j ^ 1) for j in range(gather_size)]
+                return tuple(lax.ppermute(p_, gather_axis, perm)
+                             for p_ in payload)
+            return tuple(_gather(p_) for p_ in payload)
+
+        def _chunk_payload(local_idx, val, off_i):
+            """Wire payload for ONE chunk. Packed wire: the chunk-local
+            index IS the u16 and the bucket id is the chunk's scan
+            position, recovered structurally on assembly (same one-word
+            format as encode_grouped, just chunk-at-a-time); legacy:
+            global (i32, f32) pairs."""
+            if wire_fmt is not None:
+                return (wire_mod.encode_entries(local_idx, val),)
+            return (local_idx + off_i, val)
+
+        def _pipelined_phase(state: TrainState, flat_g: jax.Array, scale,
+                             comp_rng: jax.Array):
+            """EF accumulate + per-chunk compression with the collective
+            for chunk i issued while chunk i+1 compresses. Returns
+            ``(comp, residual, nsel, cstate, acc, recv)`` — the first five
+            exactly as ``_compress_phase`` produces them (bit-identical:
+            each chunk runs the SAME batched compressor machinery as the
+            sequential uniform path, as a 1-row batch — every batched op
+            is row-independent), plus ``recv``: the per-chunk received
+            payload arrays stacked chunk-major ``[n_chunks, ...]`` for the
+            exchange tail to reassemble.
+            """
+            n_chunks = len(plan.buckets)
+            chunk, k = plan.buckets[0].size, plan.buckets[0].k
+            offs = jnp.arange(n_chunks, dtype=jnp.int32) * chunk   # [n]
+            if fused_ef is not None:
+                # multi-chunk fused eligibility guarantees chunk_pad ==
+                # chunk, so the padded rows ARE the chunks
+                _nc, _c, chunk_pad = fused_ef
+                xs = (state.ef_residual.reshape(n_chunks, chunk_pad),
+                      flat_g.reshape(n_chunks, chunk_pad),
+                      state.comp_state[0], offs)
+                acc = None
+            else:
+                acc = state.ef_residual + scale * flat_g
+                padded = n_chunks * chunk
+                x = (jnp.pad(acc, (0, padded - acc.shape[0]))
+                     if padded > acc.shape[0] else acc
+                     ).reshape(n_chunks, chunk)
+                st = (state.comp_state[0] if spec.stateful
+                      else jnp.zeros((n_chunks,), jnp.float32))
+                # same per-bucket rng derivation as compress_buckets'
+                # uniform branch — identical draws, pipelined or not
+                rngs = jax.vmap(lambda i: jax.random.fold_in(comp_rng, i))(
+                    jnp.arange(n_chunks, dtype=jnp.uint32))
+                xs = (x, st, rngs, offs)
+
+            def compress_one(xi):
+                if fused_ef is not None:
+                    res_row, g_row, st_i, off_i = xi
+                    r, st_new = spec.fused_ef_fn(
+                        res_row[None], g_row[None],
+                        jnp.asarray(scale, jnp.float32), k, st_i[None])
+                else:
+                    x_row, st_i, rng_i, off_i = xi
+                    if spec.batched_fn is not None:
+                        r, st_new = spec.batched_fn(x_row[None], k,
+                                                    st_i[None], rng_i[None])
+                    else:
+                        r, st_new = jax.vmap(
+                            lambda c, s, rg: _compressor_call(
+                                spec, c, k, s, rg))(
+                            x_row[None], st_i[None], rng_i[None])
+                return (r.compressed.indices[0], r.compressed.values[0],
+                        r.residual[0],
+                        r.num_selected.astype(jnp.int32).reshape(-1)[0],
+                        st_new[0], off_i)
+
+            # prologue: chunk 0 compresses with nothing in flight
+            first = jax.tree.map(lambda a: a[0], xs)
+            i0, v0, r0, ns0, s0, o0 = compress_one(first)
+            carry0 = _chunk_payload(i0, v0, o0)
+            rest = jax.tree.map(lambda a: a[1:], xs)
+
+            def body(in_flight, xi):
+                # the double buffer: issue chunk i's collective, THEN
+                # compress chunk i+1 — no data dependence between the two,
+                # so XLA overlaps the hop with the compress
+                recv_i = _pipeline_launch(in_flight)
+                li, v, res_row, ns, st_new, off_i = compress_one(xi)
+                return (_chunk_payload(li, v, off_i),
+                        ((li, v, res_row, ns, st_new), recv_i))
+
+            last_payload, (outs, recv_rest) = lax.scan(body, carry0, rest)
+            # epilogue: the last chunk's hop has no compress left to hide
+            # behind — this is the irreducible exposed exchange tail
+            recv_last = _pipeline_launch(last_payload)
+
+            def _stack(first_leaf, rest_leaves):
+                return jnp.concatenate([first_leaf[None], rest_leaves])
+
+            idx2d = _stack(i0, outs[0])                 # [n, k] chunk-local
+            val2d = _stack(v0, outs[1])                 # [n, k]
+            res2d = _stack(r0, outs[2])
+            nsel = _stack(ns0, outs[3])
+            cstate = _stack(s0, outs[4])
+            recv = jax.tree.map(
+                lambda last_r, rest_r: jnp.concatenate([rest_r,
+                                                        last_r[None]]),
+                recv_last, recv_rest)
+            comp = CompressedGrad((idx2d + offs[:, None]).reshape(-1),
+                                  val2d.reshape(-1))
+            residual = res2d.reshape(-1)
+            if fused_ef is None:
+                residual = residual[:acc.shape[0]]
+            return comp, residual, nsel, cstate, acc, recv
+
+        def sparse_step_fn(state: TrainState, batch: Any):
+            data_rng, comp_rng = _step_rngs(state)
+            loss, mstate, aux, new_carry, flat_g, unravel = _local_grads(
+                state, batch, data_rng, ef_numel - n_total)
+            scale = fold_lr(state.step) if fold_lr is not None else 1.0
+            if use_pipeline:
+                comp, residual, nsel, cstate, acc, recv = _pipelined_phase(
+                    state, flat_g, scale, comp_rng)
+                words = None
+            else:
+                comp, residual, nsel, cstate, acc, words = _compress_phase(
+                    state, flat_g, scale, comp_rng)
+                recv = None
+            k_packed = comp.indices.shape[0]
+            n_chunks = len(plan.buckets)
+            # trace-time byte accounting: `overlapped` is the subset of
+            # bytes_sent issued inside the scan body (chunks 0..n-2)
+            overlapped = 0
+
+            if exchange == "gtopk":
+                # butterfly gTop-k: k entries per round, log2(P) rounds;
+                # the global top-k is identical on every worker (gtopk.py).
+                # EF keeps everything not globally selected.
+                from .gtopk import (GtopkCommStats, butterfly_rounds,
+                                    global_residual, gtopk_allreduce,
+                                    merge_sparse)
+                if use_pipeline:
+                    # round 1 ran per-chunk inside the scan; reassemble the
+                    # partner's buffer chunk-major (identical to the
+                    # sequential round-1 ppermute output) and merge, then
+                    # hand the merged set to rounds 2+. The local half is
+                    # wire-roundtripped exactly where the sequential round
+                    # quantizes before its merge.
+                    if wire_fmt is not None:
+                        rel2d, dval2d = wire_mod.decode_entries(recv[0])
+                        o_idx = (rel2d + (jnp.arange(
+                            n_chunks, dtype=jnp.int32)
+                            * plan.buckets[0].size)[:, None]).reshape(-1)
+                        o_val = dval2d.reshape(-1)
+                        local_val = wire_mod.bf16_roundtrip(comp.values)
+                        round1_bytes = k_packed * 4
+                    else:
+                        o_idx = recv[0].reshape(-1)
+                        o_val = recv[1].reshape(-1)
+                        local_val = comp.values
+                        round1_bytes = k_packed * 8
+                    m_idx, m_val = merge_sparse(comp.indices, local_val,
+                                                o_idx, o_val, k_packed)
+                    m_idx, m_val, tail_bytes = butterfly_rounds(
+                        m_idx, m_val, mesh.size, gather_axis, wire_fmt,
+                        start_round=1, ablate_comm=ablate)
+                    overlapped = round1_bytes * (n_chunks - 1) // n_chunks
+                    gcomp = CompressedGrad(m_idx, m_val)
+                    comm = GtopkCommStats(
+                        bytes_sent=round1_bytes + tail_bytes,
+                        rounds=int(math.log2(mesh.size)),
+                        entries_per_round=k_packed,
+                        wire_format=(wire_fmt.name if wire_fmt is not None
+                                     else wire_mod.WIRE_LEGACY),
+                        overlapped_bytes=overlapped, pipelined=True)
+                else:
+                    # trace-time count of the buffers actually ppermuted
+                    # (shape x itemsize per round) — measured, not a formula
+                    gcomp, comm = gtopk_allreduce(comp, mesh.size,
+                                                  gather_axis, wire=wire_fmt,
+                                                  ablate_comm=ablate)
+                # the /P average rides the k-sized VALUES, not the n-sized
+                # dense buffer: one full read+write pass saved (r4 floor)
+                gcomp = gcomp._replace(
+                    values=gcomp.values / _all_axes_size())
+                if flat_opt is None:
+                    dense = decompress(gcomp, n_total, grad_dtype)
+                residual = global_residual(acc, gcomp)
+                bytes_sent = jnp.float32(comm.bytes_sent)
+            elif wire_fmt is not None:
+                # packed wire exchange (parallel/wire.py): u32 words — u16
+                # bucket-relative index | bf16 value, half the (i32, f32)
+                # payload. The receiver reconstructs global indices from
+                # (position-derived bucket id, relative offset); no i32
+                # index buffer is gathered or materialized on the wire.
+                if use_pipeline:
+                    # [n, P*k] chunk-major gathers -> the device-major
+                    # [P, n, k] flat buffer the one-shot all_gather makes
+                    g_words = (recv[0].reshape(
+                        n_chunks, gather_size, plan.buckets[0].k)
+                        .transpose(1, 0, 2).reshape(-1))
+                    overlapped = (n_chunks - 1) * plan.buckets[0].k * 4
+                    bytes_count = k_packed * 4
+                else:
+                    if words is None:   # unfused: encode from global comp
+                        words = wire_mod.encode_grouped(comp, wire_fmt)
+                    g_words = _gather(words)
+                    # measured from the concrete packed buffer handed to
+                    # the collective — never a closed-form estimate
+                    bytes_count = words.size * words.dtype.itemsize
+                g_comp = wire_mod.decode_grouped(g_words, wire_fmt, k_packed)
+                g_idx = g_comp.indices
+                g_val = g_comp.values / _all_axes_size()
+                if flat_opt is None:
+                    dense = decompress(CompressedGrad(g_idx, g_val), n_total,
+                                       grad_dtype)
+                    dense = _psum_outer(dense)
+                # EF absorbs the bf16 rounding on-device in f32: the
+                # committed residual gets back exactly (value - decoded
+                # value) at each sent index, so the quantization error
+                # never accumulates. mode='drop' for pad-chunk slots
+                # at/above the residual length.
+                q_err = comp.values - wire_mod.bf16_roundtrip(comp.values)
+                residual = residual.at[comp.indices].add(q_err, mode="drop")
+                bytes_sent = jnp.float32(bytes_count)
+            else:
+                # allgather of the packed pairs over the (ICI) gather axis,
+                # scatter-summed dense; hierarchical meshes psum the dense
+                # partial across the outer (DCN) axes (collectives.py). The
+                # /P average is applied to the k-sized gathered values
+                # BEFORE the scatter — dividing the n-sized dense buffer
+                # costs a full read+write pass; each outer-axis partial is
+                # already /P-scaled so the psum-summed result is identical.
+                if use_pipeline:
+                    k = plan.buckets[0].k
+                    g_idx = (recv[0].reshape(n_chunks, gather_size, k)
+                             .transpose(1, 0, 2).reshape(-1))
+                    g_val = (recv[1].reshape(n_chunks, gather_size, k)
+                             .transpose(1, 0, 2).reshape(-1)
+                             / _all_axes_size())
+                    overlapped = (n_chunks - 1) * k * 8
+                else:
+                    g_idx = _gather(comp.indices)
+                    g_val = _gather(comp.values) / _all_axes_size()
+                if flat_opt is None:
+                    dense = decompress(CompressedGrad(g_idx, g_val), n_total,
+                                       grad_dtype)
+                    dense = _psum_outer(dense)
+                # measured from the concrete (idx, val) buffers handed to
+                # the collectives (same count the old closed form produced)
+                bytes_sent = jnp.float32(
+                    comp.indices.size * comp.indices.dtype.itemsize
+                    + comp.values.size * comp.values.dtype.itemsize)
+
+            if flat_opt is not None:
+                # scatter the gathered pairs straight into the decayed
+                # momentum (flat_opt.py): no dense gradient buffer exists
+                if exchange == "gtopk":
+                    g_idx, g_val = gcomp.indices, gcomp.values
+                upd, m_new = flat_opt.sparse_step(
+                    state.opt_state["m"], g_idx.reshape(-1), g_val,
+                    _flat_params_if_wd(state), state.step)
+                new_state = _apply_flat(
+                    state, mstate, upd, m_new, unravel, residual, new_carry,
+                    cstate[None, :] if spec.stateful else ())
+            else:
+                new_state = _apply(state, mstate, dense, unravel, residual,
+                                   new_carry,
+                                   cstate[None, :] if spec.stateful else ())
+            if guard_nonfinite:
+                cnt = _guard_count(loss, flat_g)
+                new_state = _guard_commit(cnt == 0, state, new_state)
+                skipped = (cnt > 0).astype(jnp.float32)
+                nonfinite = cnt.astype(jnp.float32)
+            else:
+                skipped = nonfinite = jnp.float32(0)
+            # on-device comms/compression accounting (telemetry): one pmean
+            # of the per-bucket count vector serves num_selected, the
+            # achieved density, AND the per-bucket breakdown; the EF norm
+            # reads the COMMITTED residual so a guard-skipped step reports
+            # the state that actually persists
+            sel_per_bucket = _pmean(nsel.astype(jnp.float32))
+            num_selected = jnp.sum(sel_per_bucket)
+            return new_state, StepMetrics(
+                loss, aux, _pmean(jnp.linalg.norm(flat_g)),
+                num_selected, bytes_sent, skipped, nonfinite,
+                achieved_density=num_selected / n_total,
+                ef_norm=_ef_norm(new_state.ef_residual),
+                sel_per_bucket=sel_per_bucket,
+                overlapped_bytes_sent=jnp.float32(overlapped))
+
+        return sparse_step_fn
+
+    sparse_step_fn = _make_sparse_step(pipelined, False)
 
     def dense_step_fn(state: TrainState, batch: Any):
         data_rng, _ = _step_rngs(state)
@@ -835,7 +1112,8 @@ def build_dp_train_step(
             nonfinite,
             achieved_density=jnp.float32(1.0),
             ef_norm=_ef_norm(new_state.ef_residual),
-            sel_per_bucket=jnp.asarray(bucket_sizes_f32, jnp.float32))
+            sel_per_bucket=jnp.asarray(bucket_sizes_f32, jnp.float32),
+            overlapped_bytes_sent=jnp.float32(0))
 
     if sp_axis is None:
         batch_spec = P(axes)        # leading dim sharded over every dp axis
@@ -901,11 +1179,28 @@ def build_dp_train_step(
                 probe_select_fn, mesh=mesh,
                 in_specs=(state_spec, batch_spec), out_specs=P(),
                 check_vma=False)),
+            # the noexch TIMING TWIN of the full sparse step (exchange
+            # collectives -> same-shape local identities; see
+            # _make_sparse_step): step_s - t(noexch) is the EXPOSED
+            # exchange time logged as exposed_exchange_ms. NON-donating
+            # and returns the full (state, metrics) so no part of the
+            # step — the optimizer scatter included — is dead-coded out
+            # of the timed program.
+            "noexch": jax.jit(_smap(_make_sparse_step(pipelined, True))),
         }
 
     def make_multi_step(kind: str, n: int):
-        """n chained steps in one jitted program (benchmark-grade timing)."""
-        smapped = _smap(sparse_step_fn if kind == "sparse" else dense_step_fn)
+        """n chained steps in one jitted program (benchmark-grade timing).
+
+        ``kind``: 'sparse', 'dense', or 'sparse_noexch' — the sparse
+        step's comm-ablated timing twin (benchlib measures the exposed
+        exchange time as the noise-floored sparse - sparse_noexch delta).
+        """
+        fns = {"sparse": sparse_step_fn, "dense": dense_step_fn,
+               "sparse_noexch": _make_sparse_step(pipelined, True)}
+        if kind not in fns:
+            raise ValueError(f"unknown multi-step kind {kind!r}")
+        smapped = _smap(fns[kind])
 
         def run(state: TrainState, batch: Any):
             state, metrics = smapped(state, batch)
@@ -954,4 +1249,5 @@ def build_dp_train_step(
                        init_state, plan, mesh, make_multi_step, make_probes,
                        ef_numel,
                        wire_fmt.name if wire_fmt is not None
-                       else wire_mod.WIRE_LEGACY)
+                       else wire_mod.WIRE_LEGACY,
+                       "pipelined" if pipelined else "off")

@@ -12,10 +12,12 @@ from .rules import (
     KNOB_BUCKET,
     KNOB_COMPRESSOR,
     KNOB_DENSITY,
+    KNOB_OVERLAP,
     KNOB_WIRE,
     KNOBS,
     DensityRule,
     ExchangePromotionRule,
+    OverlapPromotionRule,
     PolicyDecision,
     Rule,
     RuleContext,
@@ -35,6 +37,7 @@ __all__ = [
     "SelectorRule",
     "DensityRule",
     "ExchangePromotionRule",
+    "OverlapPromotionRule",
     "default_rules",
     "load_roofline_floor",
     "KNOBS",
@@ -42,4 +45,5 @@ __all__ = [
     "KNOB_DENSITY",
     "KNOB_WIRE",
     "KNOB_BUCKET",
+    "KNOB_OVERLAP",
 ]

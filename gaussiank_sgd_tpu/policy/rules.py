@@ -28,6 +28,14 @@ switch:
   ``wire='auto'`` is paying 2× exchange bytes only because its bucket
   plan failed the packed-wire gate (parallel/wire.py: uniform plan,
   chunk ≤ 65536); propose the eligible uniform plan.
+* :class:`OverlapPromotionRule` — step-schedule promotion: a run moving
+  material exchange bytes SEQUENTIALLY (``overlap='off'``) on a plan
+  that already passes the pipeline gate (uniform, ≥2 buckets) is leaving
+  the bucket-pipelined schedule's latency hiding on the table; propose
+  ``overlap: off → auto``. Output-bit-identical by construction
+  (trainstep.py parity contract), so the only cost is the recompile —
+  which the engine charges against the decision budget and treats as a
+  program-layout change (arm records reset) like density/bucket moves.
 """
 
 from __future__ import annotations
@@ -44,7 +52,9 @@ KNOB_COMPRESSOR = "compressor"
 KNOB_DENSITY = "density"
 KNOB_WIRE = "wire"
 KNOB_BUCKET = "bucket_plan"          # value: "<policy>:<size>"
-KNOBS = (KNOB_COMPRESSOR, KNOB_DENSITY, KNOB_WIRE, KNOB_BUCKET)
+KNOB_OVERLAP = "overlap"             # value: "auto" | "off"
+KNOBS = (KNOB_COMPRESSOR, KNOB_DENSITY, KNOB_WIRE, KNOB_BUCKET,
+         KNOB_OVERLAP)
 
 
 @dataclass(frozen=True)
@@ -248,6 +258,43 @@ class ExchangePromotionRule(Rule):
                    f"plan")
 
 
+class OverlapPromotionRule(Rule):
+    """Step-schedule promotion (module docstring): flip ``overlap`` from
+    'off' to 'auto' when the run is moving material exchange bytes on a
+    bucket plan that already passes the pipeline eligibility gate — so
+    the flip actually changes the schedule instead of burning a recompile
+    on a no-op rebuild."""
+
+    name = "overlap_promotion"
+
+    def __init__(self, min_bytes_per_step: float = 1 << 20):
+        self.min_bytes_per_step = float(min_bytes_per_step)
+
+    def propose(self, snap: SignalSnapshot,
+                ctx: RuleContext) -> Optional[PolicyDecision]:
+        if ctx.knobs.get(KNOB_OVERLAP) != "off":
+            return None                      # already auto (or untracked)
+        if snap.overlap != "off":
+            return None                      # no sparse interval seen yet,
+                                             # or somehow already pipelined
+        if (snap.bytes_per_step or 0.0) < self.min_bytes_per_step:
+            return None                      # bytes too small to matter
+        # only a uniform multi-chunk plan passes the trainstep gate; on
+        # any other plan the flip would recompile into the SAME sequential
+        # program (the wire_promotion rule is the one that fixes plans)
+        if not ctx.knobs.get(KNOB_BUCKET, "").startswith("uniform:"):
+            return None
+        if ctx.banned(KNOB_OVERLAP, "auto"):
+            return None
+        return PolicyDecision(
+            step=snap.step, rule=self.name, knob=KNOB_OVERLAP, old="off",
+            new="auto",
+            reason=f"sequential exchange moving "
+                   f"{snap.bytes_per_step:.0f} B/step on a pipeline-"
+                   f"eligible uniform plan: enable the bucket-pipelined "
+                   f"schedule (output bit-identical; recompile only)")
+
+
 # -- roofline floor lookup -------------------------------------------------
 
 # trainer model name -> roofline/bench config key (analysis/roofline.py
@@ -298,4 +345,5 @@ def default_rules(cfg, floor_ms: Optional[float] = None) -> list:
         DensityRule(min_density=max(cfg.density / 8.0, 1e-5),
                     max_density=min(cfg.density * 8.0, 0.05)),
         ExchangePromotionRule(),
+        OverlapPromotionRule(),
     ]

@@ -53,6 +53,7 @@ class SignalSnapshot:
     achieved_density: Optional[float] = None
     bytes_per_step: Optional[float] = None
     wire_format: Optional[str] = None
+    overlap: Optional[str] = None
     loss_ema: Optional[float] = None
     consecutive_skips: int = 0
     skips_since: Dict[int, int] = field(default_factory=dict)
@@ -97,6 +98,7 @@ class PolicySignals:
         self._achieved: Optional[float] = None
         self._bytes: Optional[float] = None
         self._wire: Optional[str] = None
+        self._overlap: Optional[str] = None
         self._loss_ema: Optional[float] = None
         self._consecutive_skips = 0
         self._skips: Dict[int, int] = {}
@@ -193,6 +195,9 @@ class PolicySignals:
             wf = record.get("wire_format")
             if isinstance(wf, str):
                 self._wire = wf
+            ov = record.get("overlap")
+            if isinstance(ov, str):
+                self._overlap = ov
             if step_s is None or step_s <= 0:
                 return
             if self._settle_left > 0:
@@ -224,6 +229,7 @@ class PolicySignals:
                 achieved_density=self._achieved,
                 bytes_per_step=self._bytes,
                 wire_format=self._wire,
+                overlap=self._overlap,
                 loss_ema=self._loss_ema,
                 consecutive_skips=self._consecutive_skips,
                 skips_since=dict(self._skips),

@@ -74,7 +74,15 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
                   # parallel/wire.py): "u16bf16" packed or "i32f32"
                   # legacy — a bytes claim never travels without its
                   # format name (BASELINE.md protocol)
-                  "wire_format": STRING},
+                  "wire_format": STRING,
+                  # bucket-pipelined schedule (ISSUE 7): which step
+                  # schedule produced this interval ("pipelined"/"off"),
+                  # how much of bytes_sent was launched while later
+                  # chunks were still compressing, and the measured
+                  # exchange time the schedule failed to hide (step
+                  # minus its exchange-ablated timing twin)
+                  "overlap": STRING, "overlapped_bytes_sent": NUMBER,
+                  "exposed_exchange_ms": NUMBER},
     ),
     "eval": EventSchema(
         required={"step": NUMBER, "epoch": NUMBER, "val_loss": NUMBER},
@@ -132,7 +140,25 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
                   # comms wire accounting (ISSUE 5, parallel/wire.py):
                   # the fixed selector's measured per-step exchange
                   # payload and the format it was packed in
-                  "wire_format": STRING, "bytes_sent": NUMBER},
+                  "wire_format": STRING, "bytes_sent": NUMBER,
+                  # bucket-pipelined schedule (ISSUE 7): the schedule
+                  # the sparse column ran under and the exchange time
+                  # it left exposed (sparse minus exchange-ablated twin)
+                  "overlap": STRING, "exposed_exchange_ms": NUMBER},
+    ),
+    # bench.py overlap arm (ISSUE 7): one record per config that ran the
+    # off-vs-auto schedule comparison on a pipeline-eligible uniform plan.
+    # exposed_*_ms fields are omitted when the paired delta sits below
+    # that cell's round-to-round noise (benchlib.noise_floored_delta_ms)
+    "bench_overlap": EventSchema(
+        required={"key": STRING, "model": STRING, "compressor": STRING,
+                  "bucket_size": NUMBER, "n_buckets": NUMBER,
+                  "seq_step_ms": NUMBER, "pipe_step_ms": NUMBER,
+                  "seq_overlap": STRING, "pipe_overlap": STRING},
+        optional={"exposed_seq_ms": NUMBER, "exposed_pipe_ms": NUMBER,
+                  "overlapped_bytes_sent": NUMBER, "wire_format": STRING,
+                  "bytes_sent": NUMBER, "pipe_vs_seq": NUMBER,
+                  "rounds": NUMBER, "windows": NUMBER},
     ),
     "bench_summary": EventSchema(
         required={"metric": STRING, "value": NUMBER,

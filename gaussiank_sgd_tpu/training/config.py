@@ -73,6 +73,15 @@ class TrainConfig:
                                             # packed u16+bf16 when eligible,
                                             # 'off' = always legacy i32+f32
                                             # (the bf16-vs-f32 parity arm)
+    overlap: str = "auto"                   # bucket-pipelined step schedule
+                                            # (parallel/trainstep.py): 'auto'
+                                            # = per-bucket exchange issued
+                                            # while the next bucket
+                                            # compresses when the plan is
+                                            # eligible (uniform, >=2
+                                            # buckets); 'off' = sequential
+                                            # program, bit-identical to
+                                            # pre-overlap builds
     policy: str = "static"                  # 'adaptive' = telemetry-driven
                                             # policy engine retunes selector/
                                             # density/wire/bucket-plan at
@@ -227,6 +236,12 @@ def add_args(p: argparse.ArgumentParser, suppress_defaults: bool = False) -> Non
                    help="sparse-exchange wire format (parallel/wire.py): "
                         "auto = packed u16+bf16 when the plan is eligible, "
                         "off = always the legacy i32+f32 format")
+    p.add_argument("--overlap", choices=("auto", "off"), default=d.overlap,
+                   help="bucket-pipelined step (parallel/trainstep.py): "
+                        "auto = overlap each bucket's exchange with the "
+                        "next bucket's compress when the plan is eligible "
+                        "(uniform, >=2 buckets), off = the sequential "
+                        "program (bit-identical to pre-overlap builds)")
     p.add_argument("--policy", choices=("static", "adaptive"),
                    default=d.policy,
                    help="adaptive = close the loop from telemetry to "

@@ -349,6 +349,7 @@ class Trainer:
             guard_nonfinite=cfg.nonfinite_guard,
             decorrelate_comp_rng=cfg.decorrelate_comp_rng,
             wire=cfg.wire,
+            overlap=cfg.overlap,
         )
         # drop caches keyed on the replaced programs (phase-timing probes,
         # first-dispatch bookkeeping)
@@ -474,13 +475,14 @@ class Trainer:
     def _policy_knobs(self) -> Dict[str, str]:
         """Current knob values in the string form PolicyDecisions carry."""
         from ..policy import (KNOB_BUCKET, KNOB_COMPRESSOR, KNOB_DENSITY,
-                              KNOB_WIRE)
+                              KNOB_OVERLAP, KNOB_WIRE)
         cfg = self.cfg
         size = "" if cfg.bucket_size is None else str(cfg.bucket_size)
         return {KNOB_COMPRESSOR: self._comp.name,
                 KNOB_DENSITY: f"{cfg.density:g}",
                 KNOB_WIRE: cfg.wire,
-                KNOB_BUCKET: f"{cfg.bucket_policy}:{size}"}
+                KNOB_BUCKET: f"{cfg.bucket_policy}:{size}",
+                KNOB_OVERLAP: cfg.overlap}
 
     def _apply_policy(self, decision) -> None:
         """Apply one PolicyDecision at the recompile-safe boundary: mutate
@@ -488,7 +490,7 @@ class Trainer:
         programs, and re-shape the live TrainState for the new program
         layout (:meth:`_rebuild_for_policy`)."""
         from ..policy import (KNOB_BUCKET, KNOB_COMPRESSOR, KNOB_DENSITY,
-                              KNOB_WIRE)
+                              KNOB_OVERLAP, KNOB_WIRE)
         cfg = self.cfg
         knob, value = decision.knob, decision.new
         if knob == KNOB_COMPRESSOR:
@@ -505,6 +507,12 @@ class Trainer:
                                         policy=cfg.bucket_policy)
         elif knob == KNOB_WIRE:
             cfg.wire = value
+        elif knob == KNOB_OVERLAP:
+            # a program-layout change like density/bucket-plan: the engine's
+            # note_applied/note_reverted non-compressor branch resets every
+            # arm's step-time records and charges the recompile budget —
+            # timings measured under the other schedule are not comparable
+            cfg.overlap = value
         elif knob == KNOB_BUCKET:
             pol, _, size = value.partition(":")
             cfg.bucket_policy = pol
@@ -786,6 +794,19 @@ class Trainer:
             t_sel = time.perf_counter() - t0
             out["select_s"] = round(max(t_sel - t_grads, 0.0), 6)
             out["comm_update_s"] = round(max(step_s - t_sel, 0.0), 6)
+            if "noexch" in self._probes:
+                # the full-step comm-ablated twin (trainstep.py
+                # 'sparse_noexch'): step minus twin is the EXPOSED
+                # exchange time — what the pipelined schedule is paid to
+                # shrink. Logging-grade single dispatch; the
+                # noise-floored benchmark-grade number comes from
+                # bench.py's sparse_noexch arm.
+                t0 = time.perf_counter()
+                jax.block_until_ready(self._probes["noexch"](
+                    self.state, self._probe_batch))
+                t_nx = time.perf_counter() - t0
+                out["exposed_exchange_ms"] = round(
+                    max(step_s - t_nx, 0.0) * 1e3, 3)
         else:
             out["comm_update_s"] = round(max(step_s - t_grads, 0.0), 6)
         return out
@@ -839,6 +860,12 @@ class Trainer:
             # legacy); warm-up steps move a dense f32 allreduce instead,
             # so the field would be a lie there — omitted
             rec["wire_format"] = self.ts.wire_format
+            # which step schedule moved those bytes ("pipelined" | "off")
+            # — same sparse-interval gating as wire_format
+            rec["overlap"] = self.ts.overlap
+            ovl = float(jax.device_get(m.overlapped_bytes_sent))
+            if ovl:
+                rec["overlapped_bytes_sent"] = int(ovl)
         if len(self.plan.buckets) > 1:
             # per-bucket selection counts (dp-mean); single-bucket plans
             # skip the column — it would duplicate num_selected

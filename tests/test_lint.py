@@ -299,6 +299,59 @@ def test_print_in_library_quiet_on_logger_and_shadowed_print():
     assert found == []
 
 
+# -------------------------------------------- collective-outside-pipeline
+
+def test_pipeline_funnel_flags_raw_collectives_in_parallel():
+    found = run("""
+        from jax import lax
+
+        def rogue_exchange(x, axis):
+            return lax.all_gather(x, axis, tiled=True)
+
+        def rogue_rotate(v, axis):
+            return lax.ppermute(v, axis, [(0, 1)])
+        """, rule="collective-outside-pipeline",
+        path="parallel/fixture.py")
+    assert len(found) == 2
+    assert all(f.severity == "error" for f in found)
+    assert "funnel" in found[0].message
+
+
+def test_pipeline_funnel_quiet_inside_sanctioned_funnels():
+    found = run("""
+        from jax import lax
+
+        def _gather(x, axis):
+            return lax.all_gather(x, axis, tiled=True)
+
+        def butterfly_rounds(idx, val, axis):
+            def swap(v):                      # nested defs inherit the
+                return lax.ppermute(v, axis, [(0, 1)])   # funnel sanction
+            return swap(idx), swap(val)
+
+        def build(axis):
+            def _pipeline_launch(payload):
+                return tuple(lax.ppermute(p, axis, [(0, 1)])
+                             for p in payload)
+            return _pipeline_launch
+        """, rule="collective-outside-pipeline",
+        path="parallel/fixture.py")
+    assert found == []
+
+
+def test_pipeline_funnel_scoped_to_parallel_dir():
+    # the same raw collective outside parallel/ is out of scope (model
+    # code, tests, analysis scripts issue their own collectives freely)
+    found = run("""
+        from jax import lax
+
+        def anywhere(x, axis):
+            return lax.all_gather(x, axis, tiled=True)
+        """, rule="collective-outside-pipeline",
+        path="models/fixture.py")
+    assert found == []
+
+
 # ------------------------------------------------------------- suppression
 
 def test_trailing_suppression_comment():
@@ -410,12 +463,12 @@ def test_cli_clean_after_write_baseline(tmp_path):
     assert json.loads(r.stdout)["counts"]["new"] == 1
 
 
-def test_cli_list_rules_names_all_seven():
+def test_cli_list_rules_names_all_eight():
     r = _cli("--list-rules")
     assert r.returncode == 0
     for rule in ALL_RULES:
         assert rule.name in r.stdout
-    assert len(ALL_RULES) == 7
+    assert len(ALL_RULES) == 8
 
 
 def test_package_is_clean_against_committed_baseline():
