@@ -27,12 +27,16 @@ import json
 import os
 import statistics
 import sys
+import time
 from typing import List, Optional
 
 import jax
 
 from gaussiank_sgd_tpu.compressors import DEFAULT_SELECTOR
 from gaussiank_sgd_tpu.telemetry import EventBus, JSONLExporter
+from gaussiank_sgd_tpu.telemetry.history import (append_history,
+                                                 build_history_record,
+                                                 git_revision)
 
 FIXED = DEFAULT_SELECTOR        # the codified ex-ante policy (registry.py)
 SWEEP = (FIXED, "gaussian_warm", "approxtopk16")
@@ -135,6 +139,13 @@ def main(argv: Optional[List[str]] = None):
                     help="also time each config's off-vs-auto schedule "
                          "pair on a pipeline-eligible uniform plan "
                          "(ISSUE 7; always on under --smoke)")
+    ap.add_argument("--history", default=None, metavar="PATH",
+                    help="bench-history JSONL to append this run's record "
+                         "to (default: analysis/artifacts/"
+                         "bench_history.jsonl; the regression sentinel's "
+                         "input — analysis/regression_sentinel.py)")
+    ap.add_argument("--no-history", action="store_true",
+                    help="skip the history append (throwaway runs)")
     args = ap.parse_args([] if argv is None else argv)
 
     # persistent compile cache: repeated driver runs skip the multi-minute
@@ -342,6 +353,16 @@ def main(argv: Optional[List[str]] = None):
     # FINAL stdout line stays compact enough to survive any tail window
     with open(os.path.join(artifacts, "bench_last.json"), "w") as f:
         json.dump(result, f, indent=2)
+    # cross-run trajectory record (telemetry/history.py): the sentinel
+    # compares this run against the committed history with the same
+    # noise-floored machinery the bench's own deltas use
+    if not args.no_history:
+        hist_path = args.history or os.path.join(artifacts,
+                                                 "bench_history.jsonl")
+        append_history(hist_path, build_history_record(
+            result, smoke=args.smoke, ts=time.time(),
+            git_rev=git_revision(os.path.dirname(os.path.abspath(
+                __file__)))))
     compact = {
         "metric": result["metric"], "value": value, "unit": "ratio",
         "vs_baseline": result["vs_baseline"],

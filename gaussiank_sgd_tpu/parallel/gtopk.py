@@ -57,6 +57,12 @@ class GtopkCommStats(NamedTuple):
                              # the next chunk's compress); 0 sequential
     pipelined: bool = False  # True when round 1 ran per-chunk inside the
                              # pipelined step (trainstep.py overlap gate)
+    bytes_per_round: int = 0  # per-round payload bytes (bytes_sent /
+                             # rounds sequential; the pipelined step's
+                             # TAIL rounds, which round 1's per-chunk
+                             # payload does not match) — the span-source
+                             # field the offline trace reconstruction
+                             # draws nested per-round comm spans from
 
 
 def merge_sparse(idx_a: jax.Array, val_a: jax.Array, idx_b: jax.Array,
@@ -172,10 +178,12 @@ def gtopk_allreduce(comp: CompressedGrad, num_devices: int, axis_name: str,
     idx, val, bytes_sent = butterfly_rounds(
         comp.indices, comp.values, num_devices, axis_name, wire,
         start_round=0, ablate_comm=ablate_comm)
+    n_rounds = int(math.log2(num_devices))
     stats = GtopkCommStats(
-        bytes_sent=bytes_sent, rounds=int(math.log2(num_devices)),
+        bytes_sent=bytes_sent, rounds=n_rounds,
         entries_per_round=k,
-        wire_format=wire.name if wire is not None else wire_mod.WIRE_LEGACY)
+        wire_format=wire.name if wire is not None else wire_mod.WIRE_LEGACY,
+        bytes_per_round=bytes_sent // max(n_rounds, 1))
     return CompressedGrad(idx, val), stats
 
 

@@ -140,6 +140,15 @@ class StepMetrics(NamedTuple):
                               # section). 0 on the sequential program and
                               # the dense path. Trace-time static, f32
                               # for the same wrap-safety as bytes_sent.
+    # --- span-source geometry (telemetry/tracing.py): trace-time-static
+    # schedule shape, so the offline trace reconstruction can draw the
+    # per-chunk/per-round comm spans without any new host sync ---
+    pipeline_chunks: jax.Array  # float32: scan chunks the pipelined
+                              # schedule ran (== n_buckets); 0 on the
+                              # sequential program and the dense path
+    comm_rounds: jax.Array    # float32: collective rounds per step —
+                              # log2(P) on the gtopk butterfly, 1 for the
+                              # one-shot allgather and the dense psum
 
 
 # loss_fn(params, model_state, batch, rng)
@@ -952,13 +961,16 @@ def build_dp_train_step(
                         start_round=1, ablate_comm=ablate)
                     overlapped = round1_bytes * (n_chunks - 1) // n_chunks
                     gcomp = CompressedGrad(m_idx, m_val)
+                    n_rounds = int(math.log2(mesh.size))
                     comm = GtopkCommStats(
                         bytes_sent=round1_bytes + tail_bytes,
-                        rounds=int(math.log2(mesh.size)),
+                        rounds=n_rounds,
                         entries_per_round=k_packed,
                         wire_format=(wire_fmt.name if wire_fmt is not None
                                      else wire_mod.WIRE_LEGACY),
-                        overlapped_bytes=overlapped, pipelined=True)
+                        overlapped_bytes=overlapped, pipelined=True,
+                        bytes_per_round=(tail_bytes // (n_rounds - 1)
+                                         if n_rounds > 1 else round1_bytes))
                 else:
                     # trace-time count of the buffers actually ppermuted
                     # (shape x itemsize per round) — measured, not a formula
@@ -1073,7 +1085,12 @@ def build_dp_train_step(
                 achieved_density=num_selected / n_total,
                 ef_norm=_ef_norm(new_state.ef_residual),
                 sel_per_bucket=sel_per_bucket,
-                overlapped_bytes_sent=jnp.float32(overlapped))
+                overlapped_bytes_sent=jnp.float32(overlapped),
+                pipeline_chunks=jnp.float32(
+                    n_chunks if use_pipeline else 0),
+                comm_rounds=jnp.float32(
+                    int(math.log2(mesh.size)) if exchange == "gtopk"
+                    and mesh.size > 1 else 1))
 
         return sparse_step_fn
 
@@ -1113,7 +1130,9 @@ def build_dp_train_step(
             achieved_density=jnp.float32(1.0),
             ef_norm=_ef_norm(new_state.ef_residual),
             sel_per_bucket=jnp.asarray(bucket_sizes_f32, jnp.float32),
-            overlapped_bytes_sent=jnp.float32(0))
+            overlapped_bytes_sent=jnp.float32(0),
+            pipeline_chunks=jnp.float32(0),
+            comm_rounds=jnp.float32(1))
 
     if sp_axis is None:
         batch_spec = P(axes)        # leading dim sharded over every dp axis

@@ -60,6 +60,13 @@ class SignalSnapshot:
     last_rollback_step: Optional[int] = None
     arm_step_s: Dict[str, float] = field(default_factory=dict)
     arm_intervals: Dict[str, int] = field(default_factory=dict)
+    # cross-run sentinel verdicts ingested from bench_regression records
+    # (analysis/regression_sentinel.py --emit-event): how many times the
+    # tree this run is on was flagged, and the worst config named last —
+    # a standing caution the rules can weigh (a flagged tree is a bad
+    # time to explore aggressive density cuts)
+    bench_regressions: int = 0
+    last_bench_regression: Optional[str] = None
 
     def skips_after(self, step: int) -> int:
         """Guard-skipped steps observed at global steps > ``step``."""
@@ -105,6 +112,8 @@ class PolicySignals:
         self._last_rollback: Optional[int] = None
         self._arm_ema: Dict[str, float] = {}
         self._arm_n: Dict[str, int] = {}
+        self._bench_regressions = 0
+        self._last_bench_regression: Optional[str] = None
 
     # -- engine-side bookkeeping ------------------------------------------
     def bind_arm(self, arm: Optional[str]) -> None:
@@ -157,6 +166,14 @@ class PolicySignals:
                 self._skips = {s: n for s, n in self._skips.items()
                                if s <= to_step}
                 self._consecutive_skips = 0
+        elif event == "bench_regression":
+            with self._lock:
+                if record.get("status") == "regressed":
+                    self._bench_regressions += 1
+                    wc = record.get("worst_config")
+                    self._last_bench_regression = (
+                        wc if isinstance(wc, str)
+                        else str(record.get("new_rev", "unknown")))
 
     def _ingest_train(self, record: Mapping[str, object]) -> None:
         def num(key) -> Optional[float]:
@@ -236,4 +253,6 @@ class PolicySignals:
                 last_rollback_step=self._last_rollback,
                 arm_step_s=dict(self._arm_ema),
                 arm_intervals=dict(self._arm_n),
+                bench_regressions=self._bench_regressions,
+                last_bench_regression=self._last_bench_regression,
             )

@@ -22,17 +22,26 @@ from .bus import EventBus
 from .events import SCHEMA_VERSION, validate_record, validate_stream
 from .exporters import (Exporter, JSONLExporter, MemoryExporter,
                         PrometheusTextfileExporter)
+from .history import (HISTORY_SCHEMA, append_history, build_history_record,
+                      load_history)
 from .throughput import ThroughputSignals, ThroughputTracker
+from .tracing import TraceContext, build_chrome_trace
 
 __all__ = [
     "EventBus",
     "Exporter",
+    "HISTORY_SCHEMA",
     "JSONLExporter",
     "MemoryExporter",
     "PrometheusTextfileExporter",
     "SCHEMA_VERSION",
     "ThroughputSignals",
     "ThroughputTracker",
+    "TraceContext",
+    "append_history",
+    "build_chrome_trace",
+    "build_history_record",
+    "load_history",
     "validate_record",
     "validate_stream",
 ]

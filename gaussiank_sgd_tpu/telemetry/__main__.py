@@ -4,11 +4,15 @@
     python -m gaussiank_sgd_tpu.telemetry report run.jsonl --json
     python -m gaussiank_sgd_tpu.telemetry validate run.jsonl      # schema
     python -m gaussiank_sgd_tpu.telemetry validate run.jsonl --strict
+    python -m gaussiank_sgd_tpu.telemetry trace run.jsonl -o trace.json
 
 ``report`` reconstructs per-phase timing, comms-volume, compression and
 resilience summaries from the JSONL stream alone; ``validate`` schema-
 checks every record and the seq envelope (truncation, gaps, mixed-run
-resets). Exit codes: 0 ok, 1 validation problems, 2 usage error.
+resets); ``trace`` renders the stream into Chrome-trace/Perfetto JSON
+(open at ui.perfetto.dev — docs/OBSERVABILITY.md "Tracing &
+trajectory"). Exit codes: 0 ok, 1 validation problems (or, for trace
+--require-overlap, no exchange/compute overlap found), 2 usage error.
 
 Pure stdlib — runs without initializing jax (like the lint CLI).
 """
@@ -22,6 +26,7 @@ from typing import List, Optional
 
 from .events import validate_file
 from .report import format_report, load_events, summarize
+from .tracing import build_chrome_trace, chrome_trace_overlap_pairs
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -42,6 +47,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "on every record (freshly written streams)")
     vp.add_argument("--json", action="store_true", dest="as_json")
 
+    tp = sub.add_parser(
+        "trace", help="render a stream into Chrome-trace/Perfetto JSON")
+    tp.add_argument("path", help="telemetry JSONL event stream")
+    tp.add_argument("-o", "--out", required=True,
+                    help="output .json artifact (open at ui.perfetto.dev)")
+    tp.add_argument("--pid", type=int, default=0,
+                    help="worker id for this stream's track group; merge "
+                         "multi-worker runs by rendering each stream with "
+                         "a distinct --pid and concatenating traceEvents")
+    tp.add_argument("--require-overlap", action="store_true",
+                    help="exit 1 unless >= 1 exchange span overlaps a "
+                         "compress/compute span (the pipelining gate)")
+
     args = ap.parse_args(argv)
 
     try:
@@ -56,6 +74,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                   if args.as_json else format_report(summary))
             return 0
 
+        if args.cmd == "trace":
+            events = load_events(args.path)
+            if not events:
+                print(f"error: no telemetry records in {args.path}",
+                      file=sys.stderr)
+                return 1
+            trace = build_chrome_trace(events, pid=args.pid)
+            with open(args.out, "w", encoding="utf-8") as fh:
+                json.dump(trace, fh)
+            pairs = chrome_trace_overlap_pairs(trace)
+            n_x = sum(1 for ev in trace["traceEvents"]
+                      if ev.get("ph") == "X")
+            print(f"wrote {args.out}: {len(trace['traceEvents'])} trace "
+                  f"event(s), {n_x} span(s), {pairs} exchange/compute "
+                  f"overlap pair(s)")
+            if args.require_overlap and pairs < 1:
+                print("error: --require-overlap but no exchange span "
+                      "overlaps a compress/compute span", file=sys.stderr)
+                return 1
+            return 0
+
         rep = validate_file(args.path, strict=args.strict)
         if args.as_json:
             print(json.dumps({
@@ -67,6 +106,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "seq_gaps": rep.seq_gaps,
                 "seq_resets": rep.seq_resets,
                 "truncated": rep.truncated,
+                "span_orphans": rep.span_orphans,
+                "span_unclosed": rep.span_unclosed,
                 "errors": rep.errors,
                 "warnings": rep.warnings,
             }, indent=2))

@@ -41,6 +41,20 @@ class EventBus:
         self._validate = validate
         self._clock = clock
         self._closed = False
+        self._stamp: Optional[Callable[[], Mapping[str, Any]]] = None
+
+    def set_stamp(self, fn: Optional[Callable[[], Mapping[str, Any]]]) -> None:
+        """Install (or clear, with None) a per-record stamp hook.
+
+        ``fn()`` is called under the bus lock for every publish and its
+        fields are merged via ``setdefault`` — a producer that already
+        set a field wins. With no hook installed (the default) the
+        stream is byte-identical to a bus without this feature; tracing
+        uses it to stamp ``trace_id``/``span_id`` without touching any
+        producer call site.
+        """
+        with self._lock:
+            self._stamp = fn
 
     def attach(self, exporter: Exporter) -> Exporter:
         with self._lock:
@@ -74,6 +88,9 @@ class EventBus:
             rec["seq"] = self._seq
             self._seq += 1
             rec.setdefault("ts", round(self._clock(), 6))
+            if self._stamp is not None:
+                for k, v in self._stamp().items():
+                    rec.setdefault(k, v)
             if self._validate:
                 errors = validate_record(rec, strict=True)
                 if errors:

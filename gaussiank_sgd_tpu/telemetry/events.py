@@ -82,7 +82,11 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
                   # exchange time the schedule failed to hide (step
                   # minus its exchange-ablated timing twin)
                   "overlap": STRING, "overlapped_bytes_sent": NUMBER,
-                  "exposed_exchange_ms": NUMBER},
+                  "exposed_exchange_ms": NUMBER,
+                  # trace-gated span-source geometry (--trace on only;
+                  # telemetry/tracing.py reconstructs per-chunk device
+                  # phases from these trace-time-static shape facts)
+                  "pipeline_chunks": NUMBER, "comm_rounds": NUMBER},
     ),
     "eval": EventSchema(
         required={"step": NUMBER, "epoch": NUMBER, "val_loss": NUMBER},
@@ -180,6 +184,31 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
         optional={"recompiles": NUMBER, "budget_left": NUMBER,
                   "quarantined": NUMBER},   # bool passes NUMBER
     ),
+    # step-timeline tracing (telemetry/tracing.py): one record per host
+    # phase span. ``ph`` follows the Chrome-trace vocabulary: "X" complete
+    # (t0 + dur_ms), "B"/"E" begin/end of a long-lived span (the
+    # trajectory), "i" instant marker. ``span_id``/``parent_span`` form
+    # the span tree; validate_stream checks its health as WARNINGS only
+    # (orphans/unclosed are suspicious, not illegal — a crashed run ends
+    # mid-span by design).
+    "span": EventSchema(
+        required={"name": STRING, "span_id": STRING, "ph": STRING},
+        optional={"parent_span": STRING, "trace_id": STRING,
+                  "cat": STRING, "t0": NUMBER, "dur_ms": NUMBER,
+                  "step": NUMBER, "reason": STRING, "knob": STRING,
+                  "path": STRING},
+    ),
+    # cross-run regression sentinel (analysis/regression_sentinel.py):
+    # the newest bench_history.jsonl record vs a baseline, classified
+    # with noise-floored paired deltas. Published so the policy engine's
+    # signals can ingest the verdict (policy/signals.py).
+    "bench_regression": EventSchema(
+        required={"status": STRING, "baseline_rev": STRING,
+                  "new_rev": STRING, "n_regressed": NUMBER,
+                  "n_improved": NUMBER, "n_flat": NUMBER},
+        optional={"worst_config": STRING, "worst_delta": NUMBER,
+                  "tolerance": NUMBER, "smoke": NUMBER},  # bool -> NUMBER
+    ),
 }
 
 
@@ -246,6 +275,10 @@ class StreamReport:
     seq_resets: int = 0         # seq went backwards (mixed-run file)
     seq_gaps: int = 0           # seq jumped forward (dropped records)
     truncated: bool = False     # file ends mid-record
+    # span-tree health (traced streams only; always warnings, never
+    # errors — legacy non-traced streams have neither)
+    span_orphans: int = 0       # parent_span ids never declared by a span
+    span_unclosed: int = 0      # "B" spans without a matching "E"
 
     @property
     def ok(self) -> bool:
@@ -264,6 +297,13 @@ def validate_stream(lines: Iterable[str], strict: bool = False,
     rep = StreamReport()
     prev_seq: Optional[int] = None
     last_bad_line: Optional[int] = None
+    # span-tree bookkeeping: ids are resolved at END of stream because a
+    # child "X" span is emitted when it CLOSES — before its still-open
+    # parent's own record lands — so a single-pass parent check would
+    # flag every legitimate nesting as an orphan
+    span_ids: set = set()
+    open_spans: Dict[str, int] = {}          # span_id -> B line
+    parent_refs: List[Tuple[int, str]] = []  # (line, parent_span)
     for i, line in enumerate(lines, start=1):
         line = line.strip()
         if not line:
@@ -287,6 +327,24 @@ def validate_stream(lines: Iterable[str], strict: bool = False,
         for msg in validate_record(record, strict=strict):
             if len(rep.errors) < max_errors:
                 rep.errors.append(f"line {i}: {msg}")
+        if key == "span":
+            sid = record.get("span_id")
+            ph = record.get("ph")
+            if isinstance(sid, str):
+                if ph in ("X", "B", "i"):
+                    span_ids.add(sid)
+                if ph == "B":
+                    open_spans[sid] = i
+                elif ph == "E":
+                    if sid in open_spans:
+                        del open_spans[sid]
+                    else:
+                        rep.warnings.append(
+                            f"line {i}: span 'E' for {sid!r} without a "
+                            f"matching 'B' (double close or lost begin)")
+            parent = record.get("parent_span")
+            if isinstance(parent, str):
+                parent_refs.append((i, parent))
         seq = record.get("seq")
         if isinstance(seq, int) and not isinstance(seq, bool):
             rep.n_stamped += 1
@@ -310,6 +368,19 @@ def validate_stream(lines: Iterable[str], strict: bool = False,
         rep.errors.append(
             f"stream ends with a partial record at line {last_bad_line} "
             f"(truncated file)")
+    # span-tree health (warnings only — a crashed run legitimately ends
+    # mid-span, and legacy streams without spans trigger neither branch)
+    for line_no, parent in parent_refs:
+        if parent not in span_ids:
+            rep.span_orphans += 1
+            rep.warnings.append(
+                f"line {line_no}: parent_span {parent!r} never declared "
+                f"by any span record (orphan)")
+    for sid, line_no in open_spans.items():
+        rep.span_unclosed += 1
+        rep.warnings.append(
+            f"span {sid!r} opened at line {line_no} never closed "
+            f"(crashed mid-span, or a missing end())")
     return rep
 
 

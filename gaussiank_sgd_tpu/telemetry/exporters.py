@@ -112,7 +112,23 @@ class PrometheusTextfileExporter(Exporter):
     atomically (tmp + rename — the collector must never scrape a torn
     file). Strings/lists are skipped: Prometheus is numbers-only; the
     JSONL stream is the full-fidelity record.
+
+    Comms-volume fields additionally accumulate as monotonic counters
+    (``_total`` suffix) so dashboards can ``rate()`` the wire traffic:
+    ``<prefix>_train_bytes_sent_total`` and
+    ``<prefix>_train_overlapped_bytes_sent_total`` sum the logged
+    per-step payloads across intervals (sampled totals — the trainer
+    logs every ``log_every`` steps, so multiply by the cadence for an
+    absolute estimate). The exposed exchange time stays a gauge
+    (``<prefix>_train_exposed_exchange_ms``): it is a level, not a
+    volume.
     """
+
+    # per-event numeric fields that accumulate as *_total counters
+    # alongside their latest-value gauges
+    COUNTER_FIELDS: Mapping[str, tuple] = {
+        "train": ("bytes_sent", "overlapped_bytes_sent"),
+    }
 
     def __init__(self, path: str, prefix: str = "gksgd",
                  write_every: int = 1):
@@ -124,6 +140,7 @@ class PrometheusTextfileExporter(Exporter):
         self.write_every = write_every
         self._gauges: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}
+        self._counters: Dict[str, float] = {}
         self._since_write = 0
         self._lock = threading.Lock()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -143,6 +160,15 @@ class PrometheusTextfileExporter(Exporter):
                 if isinstance(v, (int, float)):
                     name = f"{self.prefix}_{ev}_{_METRIC_CHARS.sub('_', k)}"
                     self._gauges[name] = float(v)
+            for k in self.COUNTER_FIELDS.get(event, ()):
+                v = record.get(k)
+                if isinstance(v, bool):
+                    v = int(v)
+                if isinstance(v, (int, float)):
+                    name = (f"{self.prefix}_{ev}_"
+                            f"{_METRIC_CHARS.sub('_', k)}_total")
+                    self._counters[name] = (self._counters.get(name, 0.0)
+                                            + float(v))
             self._since_write += 1
             if self._since_write >= self.write_every:
                 self._write_locked()
@@ -153,6 +179,8 @@ class PrometheusTextfileExporter(Exporter):
             lines.append(
                 f'{self.prefix}_events_total{{event="{ev}"}} '
                 f"{self._counts[ev]}\n")
+        for name in sorted(self._counters):
+            lines.append(f"{name} {self._counters[name]:.10g}\n")
         for name in sorted(self._gauges):
             lines.append(f"{name} {self._gauges[name]:.10g}\n")
         tmp = f"{self.path}.tmp.{os.getpid()}"

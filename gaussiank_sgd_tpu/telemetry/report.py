@@ -126,6 +126,49 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     summary["comms"] = comms
     summary["compression"] = compression
 
+    # overlap efficiency (docs/PERFORMANCE.md §5): how much of the sparse
+    # payload the pipelined schedule launched while later chunks were
+    # still compressing, and the exchange time it still left exposed
+    pipelined = [r for r in train if r.get("overlap") == "pipelined"]
+    if pipelined:
+        fracs = [float(r["overlapped_bytes_sent"]) / float(r["bytes_sent"])
+                 for r in pipelined
+                 if isinstance(r.get("overlapped_bytes_sent"), (int, float))
+                 and not isinstance(r.get("overlapped_bytes_sent"), bool)
+                 and float(r.get("bytes_sent", 0) or 0) > 0]
+        summary["overlap"] = {
+            "pipelined_intervals": len(pipelined),
+            "overlapped_frac_mean": _mean(fracs),
+            "exposed_exchange_ms_mean": _mean(
+                _collect(pipelined, "exposed_exchange_ms")),
+        }
+    bench_ovl = by_kind.get("bench_overlap", [])
+    if bench_ovl:
+        summary["bench_overlap"] = [
+            {k: r.get(k) for k in ("key", "n_buckets", "seq_step_ms",
+                                   "pipe_step_ms", "pipe_vs_seq",
+                                   "exposed_seq_ms", "exposed_pipe_ms",
+                                   "overlapped_bytes_sent")}
+            for r in bench_ovl]
+
+    # adaptive policy decision log (docs/ADAPTIVE.md): applies + reverts
+    # in stream order, so the report shows WHAT the closed loop did and
+    # why without replaying the run
+    decisions = by_kind.get("policy_decision", [])
+    reverts = by_kind.get("policy_revert", [])
+    if decisions or reverts:
+        chron = sorted(decisions + reverts,
+                       key=lambda r: (r.get("seq") is None,
+                                      r.get("seq", 0)))
+        summary["policy"] = {
+            "decisions": len(decisions),
+            "reverts": len(reverts),
+            "log": [{"kind": r["event"], "step": r.get("step"),
+                     "rule": r.get("rule"), "knob": r.get("knob"),
+                     "old": r.get("old"), "new": r.get("new"),
+                     "reason": r.get("reason")} for r in chron],
+        }
+
     rollbacks = by_kind.get("rollback", [])
     summary["resilience"] = {
         "skips": len(by_kind.get("skip", [])),
@@ -228,6 +271,44 @@ def format_report(summary: Dict[str, Any]) -> str:
     if cp.get("ef_norm_last") is not None:
         lines.append(f"  EF-residual norm (last)  "
                      f"{_fmt(cp['ef_norm_last'], digits=5)}")
+
+    if "overlap" in s:
+        ov = s["overlap"]
+        lines.append("== overlap efficiency ==")
+        lines.append(
+            f"  pipelined intervals  {ov['pipelined_intervals']}  "
+            f"overlapped payload "
+            f"{_fmt(ov['overlapped_frac_mean'])} of bytes_sent  "
+            f"exposed exchange "
+            f"{_fmt(ov['exposed_exchange_ms_mean'], ' ms', digits=4)}")
+    if "bench_overlap" in s:
+        lines.append("== bench overlap arm (off vs pipelined) ==")
+        for row in s["bench_overlap"]:
+            exp = (f"exposed {_fmt(row.get('exposed_seq_ms'), digits=4)}"
+                   f" -> {_fmt(row.get('exposed_pipe_ms'), digits=4)} ms"
+                   if row.get("exposed_seq_ms") is not None
+                   or row.get("exposed_pipe_ms") is not None
+                   else "exposed delta below noise floor")
+            lines.append(
+                f"  {row.get('key', '?'):<24} "
+                f"{_fmt(row.get('seq_step_ms'), digits=4)} -> "
+                f"{_fmt(row.get('pipe_step_ms'), digits=4)} ms "
+                f"({_fmt(row.get('pipe_vs_seq'))}x, "
+                f"{row.get('n_buckets', '?')} buckets)  {exp}")
+
+    if "policy" in s:
+        p = s["policy"]
+        lines.append(f"== policy decision log "
+                     f"({p['decisions']} applied, {p['reverts']} "
+                     f"reverted) ==")
+        for d in p["log"]:
+            arrow = "applied" if d["kind"] == "policy_decision" \
+                else "REVERTED"
+            lines.append(
+                f"  step {d.get('step', '?'):>6}  {arrow:<8} "
+                f"[{d.get('rule', '?')}] {d.get('knob', '?')}: "
+                f"{d.get('old', '?')} -> {d.get('new', '?')}  "
+                f"({d.get('reason', '?')})")
 
     r = s["resilience"]
     lines.append("== resilience ==")

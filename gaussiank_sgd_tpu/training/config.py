@@ -91,6 +91,15 @@ class TrainConfig:
                                             # knobs stay exactly as
                                             # configured (bit-identical to
                                             # pre-policy behavior)
+    trace: str = "off"                      # 'on' = span-based step tracing
+                                            # (telemetry/tracing.py): host
+                                            # phase spans + trace_id/span_id
+                                            # stamped on every bus record;
+                                            # 'off' = event stream identical
+                                            # byte-for-byte to pre-tracing
+                                            # builds. Render with
+                                            # `python -m gaussiank_sgd_tpu.
+                                            # telemetry trace`
 
     # numerics
     compute_dtype: str = "bfloat16"         # MXU-native compute
@@ -248,6 +257,11 @@ def add_args(p: argparse.ArgumentParser, suppress_defaults: bool = False) -> Non
                         "selector/density/wire/bucket retuning at "
                         "recompile-safe boundaries (docs/ADAPTIVE.md); "
                         "static = knobs stay as configured")
+    p.add_argument("--trace", choices=("off", "on"), default=d.trace,
+                   help="span-based step tracing (telemetry/tracing.py): "
+                        "on = emit host-phase span records and stamp "
+                        "trace_id/span_id on every event; off = stream "
+                        "byte-identical to pre-tracing builds")
     p.add_argument("--compress-warmup-steps", dest="compress_warmup_steps",
                    type=int, default=d.compress_warmup_steps)
     p.add_argument("--fold-lr", dest="fold_lr",

@@ -88,18 +88,25 @@ class ResilienceMonitor:
         self._ema_obs = 0
         self._pending: Optional[str] = None
         self._pending_step: Optional[int] = None
-        # optional (reason, step) callback fired the moment an anomaly
+        # optional (reason, step) callbacks fired the moment an anomaly
         # first becomes pending — the adaptive policy engine's safety-net
         # hookup (docs/ADAPTIVE.md): a decision preceding an anomaly is
-        # reverted BEFORE the rollback executes
-        self._on_anomaly = on_anomaly
+        # reverted BEFORE the rollback executes. More hooks can ride
+        # along via add_anomaly_hook (the tracer's instant marker) —
+        # hooks run in registration order and must not raise.
+        self._anomaly_hooks = [on_anomaly] if on_anomaly is not None else []
+
+    def add_anomaly_hook(self, hook) -> None:
+        """Register an extra (reason, step) callback alongside any
+        engine hook passed at construction."""
+        self._anomaly_hooks.append(hook)
 
     def _set_pending(self, reason: str, step: int) -> None:
         if self._pending is None:
             self._pending = reason
             self._pending_step = step
-            if self._on_anomaly is not None:
-                self._on_anomaly(reason, step)
+            for hook in self._anomaly_hooks:
+                hook(reason, step)
 
     def observe(self, step: int, loss: float, skipped: float) -> None:
         p = self.policy

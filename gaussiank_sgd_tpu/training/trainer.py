@@ -20,6 +20,7 @@ trainer is a thin host loop feeding batches and draining metrics
 
 from __future__ import annotations
 
+import contextlib
 import math
 import os
 import threading
@@ -51,6 +52,7 @@ from .resilience import (GracefulShutdown, ResilienceMonitor,
 from ..telemetry import (EventBus, JSONLExporter,
                          PrometheusTextfileExporter, ThroughputTracker)
 from ..telemetry.profiler import ProfilerSession
+from ..telemetry.tracing import TraceContext
 
 
 def _dtype_of(name: str):
@@ -80,6 +82,13 @@ class Trainer:
         if cfg.prom_textfile:
             exporters.append(PrometheusTextfileExporter(cfg.prom_textfile))
         self.bus = EventBus(exporters)
+        # span-based step tracing (telemetry/tracing.py): opt-in — with
+        # trace off, no stamp hook is installed and no span records are
+        # emitted, so the stream is byte-identical to pre-tracing builds
+        self.trace: Optional[TraceContext] = None
+        self._traj_span: Optional[str] = None
+        if cfg.trace == "on":
+            self.trace = TraceContext(self.bus).install()
         self.tracker = ThroughputTracker(window=cfg.telemetry_window)
         self._flops_per_step: Optional[float] = None
         self._peak_flops: Optional[float] = None
@@ -204,6 +213,13 @@ class Trainer:
             lr_backoff=cfg.lr_backoff,
             max_rollbacks=cfg.max_rollbacks)
         self.monitor = ResilienceMonitor(policy) if policy.active else None
+        if self.monitor is not None and self.trace is not None:
+            # instant marker the moment an anomaly first goes pending, so
+            # the trace shows detection separately from the (later,
+            # boundary-deferred) rollback span
+            self.monitor.add_anomaly_hook(
+                lambda reason, step: self.trace.instant(
+                    "anomaly_pending", reason=reason, step=step))
 
         # ---- adaptive policy engine (docs/ADAPTIVE.md) ----
         # default 'static' builds NO engine object at all: the train loop's
@@ -294,6 +310,11 @@ class Trainer:
             os.path.join(run_dir, "profile"), cfg.profile_steps[0],
             cfg.profile_steps[1], bus=self.bus, logger=self.logger)
             if cfg.profile_steps else None)
+        # long-lived trajectory span: every host span and stamped record
+        # between rollbacks parents to it; a rollback rotates it
+        # (_rotate_trajectory), so each trajectory is one span-tree root
+        if self.trace is not None:
+            self._traj_span = self.trace.begin("trajectory", step=self.step)
 
     # ------------------------------------------------------------------
     def _build_steps(self) -> None:
@@ -389,6 +410,21 @@ class Trainer:
         # worth a teardown protocol
         self._iter = None
 
+    def _span(self, name: str, **fields):
+        """Host-phase span when tracing is on, else a free nullcontext —
+        call sites stay unconditional and trace-off stays zero-record."""
+        return (self.trace.span(name, **fields) if self.trace is not None
+                else contextlib.nullcontext())
+
+    def _rotate_trajectory(self, reason: str) -> None:
+        """A rollback abandons the old trajectory: close its span and open
+        a fresh root so post-rollback records parent to the new one."""
+        if self.trace is None:
+            return
+        if self._traj_span is not None:
+            self.trace.end(self._traj_span, reason=reason)
+        self._traj_span = self.trace.begin("trajectory", step=self.step)
+
     # ------------------------------------------------------------------
     def _save_checkpoint(self) -> str:
         """Seal a checkpoint for the current step. A step already saved by
@@ -399,18 +435,20 @@ class Trainer:
         a backed-off LR) — silently keeping the stale state would poison a
         later resume/rollback."""
         step = self.step
-        # unpadded_numel strips the fused-EF block pad (identity on
-        # unpadded runs) so the on-disk format stays [P, total_numel]
-        path = save_checkpoint(self.ckpt_dir, self._state,
-                               overwrite=step not in self._saved_steps,
-                               unpadded_numel=self.plan.total_numel)
-        self._saved_steps.add(step)
-        self.bus.publish({"event": "checkpoint", "step": step, "path": path})
-        if self.cfg.keep_checkpoints:
-            removed = gc_checkpoints(self.ckpt_dir,
-                                     self.cfg.keep_checkpoints)
-            for r in removed:
-                self.logger.info("checkpoint GC: removed %s", r)
+        with self._span("checkpoint_save", step=step):
+            # unpadded_numel strips the fused-EF block pad (identity on
+            # unpadded runs) so the on-disk format stays [P, total_numel]
+            path = save_checkpoint(self.ckpt_dir, self._state,
+                                   overwrite=step not in self._saved_steps,
+                                   unpadded_numel=self.plan.total_numel)
+            self._saved_steps.add(step)
+            self.bus.publish({"event": "checkpoint", "step": step,
+                              "path": path})
+            if self.cfg.keep_checkpoints:
+                removed = gc_checkpoints(self.ckpt_dir,
+                                         self.cfg.keep_checkpoints)
+                for r in removed:
+                    self.logger.info("checkpoint GC: removed %s", r)
         return path
 
     def _log_restore_skip(self, path: str, exc: Exception) -> None:
@@ -522,7 +560,8 @@ class Trainer:
                                         policy=cfg.bucket_policy)
         else:
             raise ValueError(f"unknown policy knob {knob!r}")
-        self._rebuild_for_policy()
+        with self._span("policy_rebuild", knob=knob):
+            self._rebuild_for_policy()
 
     def _rebuild_for_policy(self) -> None:
         """Rebuild the step programs for retuned knobs and migrate the
@@ -566,7 +605,9 @@ class Trainer:
         eng = self.engine
         revert = eng.check_revert(rollback_pending=rollback_pending)
         if revert is not None:
-            self._apply_policy(revert)
+            with self._span("policy_apply", knob=revert.knob,
+                            reason=revert.reason):
+                self._apply_policy(revert)
             eng.note_reverted(revert)
             self.logger.warning("policy revert %s: %s -> %s (%s)",
                                 revert.knob, revert.old, revert.new,
@@ -578,7 +619,9 @@ class Trainer:
             return
         decision = eng.decide()
         if decision is not None:
-            self._apply_policy(decision)
+            with self._span("policy_apply", knob=decision.knob,
+                            reason=decision.reason):
+                self._apply_policy(decision)
             eng.note_applied(decision)
             self.logger.info("policy decision [%s] %s: %s -> %s (%s)",
                              decision.rule, decision.knob, decision.old,
@@ -614,7 +657,8 @@ class Trainer:
             # cached iterator, and the rebuilt one must be picked up here
             it = data_iter if data_iter is not None else self._train_iter()
             self.timers.start("io")
-            batch = next(it)
+            with self._span("data_wait"):
+                batch = next(it)
             batch = shard_batch(self.mesh, batch, spec=self._batch_spec)
             self._probe_batch = batch      # for _phase_breakdown at log time
             self.timers.start("step")
@@ -647,10 +691,11 @@ class Trainer:
                     self._dispatched_fns.add(key)
                     self._interval_has_compile = True
             t_step0 = time.perf_counter()
-            self._state, m = fn(self._state, batch)
-            # jit dispatch is async: sync before stopping the timer so
-            # step_s/ex-s measure device work, not dispatch latency
-            jax.block_until_ready(m.loss)
+            with self._span("step_dispatch", step=step + 1):
+                self._state, m = fn(self._state, batch)
+                # jit dispatch is async: sync before stopping the timer so
+                # step_s/ex-s measure device work, not dispatch latency
+                jax.block_until_ready(m.loss)
             step_wall = time.perf_counter() - t_step0
             self._step_cache = step + 1
             self.timers.stop()
@@ -716,7 +761,12 @@ class Trainer:
                 if self.engine is not None and not self._in_warmup(done):
                     self._policy_tick(rollback_pending=reason is not None)
                 if reason:
-                    self._rollback(reason)
+                    # the rollback span closes inside the OLD trajectory
+                    # (it is that trajectory's terminal act); only then is
+                    # the root rotated for the restored one
+                    with self._span("rollback", reason=reason):
+                        self._rollback(reason)
+                    self._rotate_trajectory(reason)
         if losses and not last:
             last = self._log_train(self.step, losses[-1], quiet=True)
         return last
@@ -866,6 +916,14 @@ class Trainer:
             ovl = float(jax.device_get(m.overlapped_bytes_sent))
             if ovl:
                 rec["overlapped_bytes_sent"] = int(ovl)
+            if self.trace is not None:
+                # span-source geometry for the offline device-phase
+                # reconstruction (telemetry/tracing.py) — trace-gated so
+                # default streams stay byte-identical to pre-tracing runs
+                rec["pipeline_chunks"] = int(
+                    float(jax.device_get(m.pipeline_chunks)))
+                rec["comm_rounds"] = int(
+                    float(jax.device_get(m.comm_rounds)))
         if len(self.plan.buckets) > 1:
             # per-bucket selection counts (dp-mean); single-bucket plans
             # skip the column — it would duplicate num_selected
@@ -995,4 +1053,11 @@ class Trainer:
     def close(self):
         if self.profiler is not None:
             self.profiler.close()      # stop a still-live trace first
+        if self.trace is not None:
+            # seal the trajectory root, then detach the stamp hook so a
+            # reused bus never inherits a dead trace context
+            if self._traj_span is not None:
+                self.trace.end(self._traj_span)
+                self._traj_span = None
+            self.trace.uninstall()
         self.bus.close()

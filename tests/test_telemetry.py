@@ -139,6 +139,28 @@ def test_prometheus_textfile_exporter(tmp_path):
                 if ".tmp." in f], "tmp file must be renamed away"
 
 
+def test_prometheus_comms_counters_accumulate(tmp_path):
+    """bytes_sent/overlapped_bytes_sent additionally export as monotonic
+    *_total counters (rate()-able wire traffic), while exposed_exchange_ms
+    stays a latest-value gauge — and the write is still tmp+rename."""
+    path = str(tmp_path / "gksgd.prom")
+    ex = PrometheusTextfileExporter(path)
+    for exposed in (2.0, 1.5):
+        ex.emit({"event": "train", "step": 1, "bytes_sent": 100,
+                 "overlapped_bytes_sent": 60,
+                 "exposed_exchange_ms": exposed})
+    ex.emit({"event": "skip", "step": 2, "nonfinite": 1.0})  # no counters
+    ex.close()
+    lines = dict(l.rsplit(" ", 1) for l in open(path).read().splitlines()
+                 if l and not l.startswith("#"))
+    assert float(lines["gksgd_train_bytes_sent_total"]) == 200
+    assert float(lines["gksgd_train_overlapped_bytes_sent_total"]) == 120
+    assert float(lines["gksgd_train_bytes_sent"]) == 100       # gauge: last
+    assert float(lines["gksgd_train_exposed_exchange_ms"]) == 1.5
+    assert "gksgd_skip_step_total" not in lines
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
 # --------------------------------------------------------------- validation
 
 def test_validate_record_compat_and_strict():
