@@ -105,7 +105,11 @@ def pick_baseline(history: List[Dict[str, Any]], new: Mapping[str, Any],
     """The record to compare against: explicit rev/index, else the
     latest EARLIER record with the same smoke flag and >= 1 shared
     config (smoke timings on a CI runner say nothing about a real run's
-    trajectory, and vice versa)."""
+    trajectory, and vice versa). Records marked ``"synthetic": true``
+    (hand-authored seed/demo rows, never bench output) are skipped on
+    the auto path — a verdict must anchor to measured numbers; the
+    explicit --baseline-rev/--baseline-index overrides still reach
+    them."""
     if baseline_index is not None:
         return (history[baseline_index]
                 if -len(history) <= baseline_index < len(history) else None)
@@ -121,6 +125,8 @@ def pick_baseline(history: List[Dict[str, Any]], new: Mapping[str, Any],
         if rec.get("ts", 0) > new.get("ts", 0):
             continue
         if bool(rec.get("smoke")) != bool(new.get("smoke")):
+            continue
+        if rec.get("synthetic"):
             continue
         if new_keys & set((rec.get("configs") or {}).keys()):
             return rec

@@ -14,6 +14,12 @@ Append-only and forward-compatible by the same contract as the event
 catalog: new fields only ever ADD; readers skip records whose
 ``history_schema`` is newer than theirs. Pure stdlib — the telemetry
 CLI must run without jax.
+
+Every committed record must be real bench output. A hand-authored row
+(seed data for a demo, a fixture) must carry ``"synthetic": true`` —
+``build_history_record`` never sets it, and the sentinel's automatic
+baseline selection skips such rows, so a verdict can only ever anchor
+to measured numbers.
 """
 
 from __future__ import annotations

@@ -45,12 +45,17 @@ def _fake_bench_model(model, dataset, batch, density, compressors, n_steps,
     return times
 
 
-def test_bench_json_contract(monkeypatch, capsys):
+def test_bench_json_contract(monkeypatch, capsys, tmp_path):
     import gaussiank_sgd_tpu.benchlib as benchlib
     monkeypatch.setattr(benchlib, "bench_model", _fake_bench_model)
     sys.modules.pop("bench", None)
     bench = importlib.import_module("bench")
-    result = bench.main()
+    # --history -> tmp: the default path is the COMMITTED sentinel data
+    # layer, and this run's numbers are the deterministic fake's — they
+    # must never be appended to real history (they'd masquerade as a
+    # measured full bench, identical on every test run)
+    hist = tmp_path / "hist.jsonl"
+    result = bench.main(["--history", str(hist)])
     out_lines = [l for l in capsys.readouterr().out.splitlines()
                  if l.startswith("{")]
     assert len(out_lines) == 1                 # exactly ONE JSON line
@@ -101,6 +106,14 @@ def test_bench_json_contract(monkeypatch, capsys):
     assert result["detail"]["flagship_ratio_median"] == \
         cfgs["resnet20"]["ratio_median"]
     assert "winner_secondary" in cfgs["resnet20"]
+
+    # the run appended exactly one history record to the redirected path
+    from gaussiank_sgd_tpu.telemetry.history import load_history
+    recs = load_history(str(hist))
+    assert len(recs) == 1
+    assert recs[0]["smoke"] is False
+    assert set(recs[0]["configs"]) == set(cfgs)
+    assert recs[0]["value"] == result["value"]
 
 
 def test_bench_config5_matches_exp_config_operating_point():

@@ -349,7 +349,7 @@ def test_sentinel_scalar_fallback_without_window_medians():
 
 def test_sentinel_baseline_scoping():
     """Baseline picking skips records with a different smoke flag, later
-    timestamps, and disjoint configs."""
+    timestamps, disjoint configs, and hand-authored synthetic rows."""
     hist = [
         _history_rec("real0000", 50.0, smoke=False),
         _history_rec("other000", 60.0, key="vgg16"),
@@ -360,6 +360,17 @@ def test_sentinel_baseline_scoping():
     assert base is not None and base["git_rev"] == "good0000"
     only = [_history_rec("lonely00", 10.0)]
     assert pick_baseline(only, only[0], None, None) is None
+    # a "synthetic": true seed row must never anchor a verdict on the
+    # auto path — but an explicit --baseline-rev still reaches it
+    fake = dict(_history_rec("fake0000", 80.0), synthetic=True)
+    hist_f = [_history_rec("good0000", 70.0), fake,
+              _history_rec("new00000", 100.0)]
+    base = pick_baseline(hist_f, hist_f[-1], None, None)
+    assert base is not None and base["git_rev"] == "good0000"
+    newest = _history_rec("new00000", 100.0)
+    assert pick_baseline([fake, newest], newest, None, None) is None
+    explicit = pick_baseline(hist_f, hist_f[-1], "fake0000", None)
+    assert explicit is not None and explicit["git_rev"] == "fake0000"
 
 
 def test_sentinel_cli_end_to_end(tmp_path, capsys):
