@@ -146,6 +146,14 @@ class PolicyEngine:
             return None
 
         snap = self.signals.snapshot()
+        if snap.health_state > 0:
+            # the run-health monitor attributes a live degradation
+            # (telemetry/health.py): exploring now would retune against
+            # conditions that won't persist AND muddy the monitor's
+            # cause attribution — hold until the run is ok again.
+            # check_revert is untouched by this gate: probation reverts
+            # are protective, not exploratory
+            return None
         ctx = self._context()
         proposed: Dict[Tuple[str, str], PolicyDecision] = {}
         for rule in self.rules:

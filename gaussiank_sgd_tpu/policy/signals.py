@@ -67,6 +67,15 @@ class SignalSnapshot:
     # time to explore aggressive density cuts)
     bench_regressions: int = 0
     last_bench_regression: Optional[str] = None
+    # latest run-health verdict ingested from health_status records
+    # (telemetry/health.py, --health on): 0 ok / 1 degraded / 2 critical
+    # plus the attributed causes. The engine holds exploration while the
+    # run is non-ok — retuning knobs mid-incident would confound the
+    # monitor's cause attribution AND measure the new arm under
+    # conditions that won't persist. Stays 0/() when health is off, so
+    # static-health runs decide identically to pre-health builds.
+    health_state: int = 0
+    health_causes: Tuple[str, ...] = ()
 
     def skips_after(self, step: int) -> int:
         """Guard-skipped steps observed at global steps > ``step``."""
@@ -114,6 +123,8 @@ class PolicySignals:
         self._arm_n: Dict[str, int] = {}
         self._bench_regressions = 0
         self._last_bench_regression: Optional[str] = None
+        self._health_state = 0
+        self._health_causes: Tuple[str, ...] = ()
 
     # -- engine-side bookkeeping ------------------------------------------
     def bind_arm(self, arm: Optional[str]) -> None:
@@ -166,6 +177,17 @@ class PolicySignals:
                 self._skips = {s: n for s, n in self._skips.items()
                                if s <= to_step}
                 self._consecutive_skips = 0
+        elif event == "health_status":
+            with self._lock:
+                code = record.get("state_code")
+                if isinstance(code, (int, float)) \
+                        and not isinstance(code, bool):
+                    self._health_state = int(code)
+                causes = record.get("causes")
+                self._health_causes = tuple(
+                    c for c in (causes if isinstance(causes, (list, tuple))
+                                else ())
+                    if isinstance(c, str))
         elif event == "bench_regression":
             with self._lock:
                 if record.get("status") == "regressed":
@@ -255,4 +277,6 @@ class PolicySignals:
                 arm_intervals=dict(self._arm_n),
                 bench_regressions=self._bench_regressions,
                 last_bench_regression=self._last_bench_regression,
+                health_state=self._health_state,
+                health_causes=self._health_causes,
             )

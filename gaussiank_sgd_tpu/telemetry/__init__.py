@@ -22,6 +22,8 @@ from .bus import EventBus
 from .events import SCHEMA_VERSION, validate_record, validate_stream
 from .exporters import (Exporter, JSONLExporter, MemoryExporter,
                         PrometheusTextfileExporter)
+from .health import (HealthMonitor, HealthPolicy, HealthServer,
+                     replay_health)
 from .history import (HISTORY_SCHEMA, append_history, build_history_record,
                       load_history)
 from .throughput import ThroughputSignals, ThroughputTracker
@@ -31,6 +33,9 @@ __all__ = [
     "EventBus",
     "Exporter",
     "HISTORY_SCHEMA",
+    "HealthMonitor",
+    "HealthPolicy",
+    "HealthServer",
     "JSONLExporter",
     "MemoryExporter",
     "PrometheusTextfileExporter",
@@ -42,6 +47,7 @@ __all__ = [
     "build_chrome_trace",
     "build_history_record",
     "load_history",
+    "replay_health",
     "validate_record",
     "validate_stream",
 ]

@@ -5,6 +5,7 @@
     python -m gaussiank_sgd_tpu.telemetry validate run.jsonl      # schema
     python -m gaussiank_sgd_tpu.telemetry validate run.jsonl --strict
     python -m gaussiank_sgd_tpu.telemetry trace run.jsonl -o trace.json
+    python -m gaussiank_sgd_tpu.telemetry health run.jsonl     # verdict
 
 ``report`` reconstructs per-phase timing, comms-volume, compression and
 resilience summaries from the JSONL stream alone; ``validate`` schema-
@@ -13,6 +14,12 @@ resets); ``trace`` renders the stream into Chrome-trace/Perfetto JSON
 (open at ui.perfetto.dev — docs/OBSERVABILITY.md "Tracing &
 trajectory"). Exit codes: 0 ok, 1 validation problems (or, for trace
 --require-overlap, no exchange/compute overlap found), 2 usage error.
+
+``health`` replays the stream through the run-health monitor
+(docs/OBSERVABILITY.md "Run health") and exits by the WORST state the
+run reached — 0 ok, 1 degraded, 2 critical — so a CI gate is just the
+exit code; a missing/empty stream exits 3 (distinguishable from a
+critical verdict).
 
 Pure stdlib — runs without initializing jax (like the lint CLI).
 """
@@ -25,6 +32,7 @@ import sys
 from typing import List, Optional
 
 from .events import validate_file
+from .health import format_health, replay_health
 from .report import format_report, load_events, summarize
 from .tracing import build_chrome_trace, chrome_trace_overlap_pairs
 
@@ -60,7 +68,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="exit 1 unless >= 1 exchange span overlaps a "
                          "compress/compute span (the pipelining gate)")
 
+    hp = sub.add_parser(
+        "health", help="replay a stream through the run-health monitor; "
+                       "exit 0/1/2 by worst state (3 = no stream)")
+    hp.add_argument("path", help="telemetry JSONL event stream")
+    hp.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable summary")
+    hp.add_argument("--floor-ms", type=float, default=None,
+                    dest="floor_ms",
+                    help="roofline exchange floor for the "
+                         "exposed_exchange detector (live runs read it "
+                         "from analysis/artifacts/roofline.json)")
+
     args = ap.parse_args(argv)
+
+    if args.cmd == "health":
+        # worst-state exit codes 0/1/2 are this subcommand's contract,
+        # so its file errors exit 3 — never aliasing a critical verdict
+        try:
+            events = load_events(args.path)
+        except FileNotFoundError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 3
+        if not events:
+            print(f"error: no telemetry records in {args.path}",
+                  file=sys.stderr)
+            return 3
+        _, mon = replay_health(events, floor_ms=args.floor_ms)
+        health = mon.summary()
+        print(json.dumps(health, indent=2, default=float)
+              if args.as_json else format_health(health))
+        return int(health["worst_state_code"])
 
     try:
         if args.cmd == "report":

@@ -36,6 +36,7 @@ ENVELOPE_FIELDS = ("schema_version", "seq", "ts")
 NUMBER: Tuple[type, ...] = (int, float)
 STRING: Tuple[type, ...] = (str,)
 ARRAY: Tuple[type, ...] = (list, tuple)
+OBJECT: Tuple[type, ...] = (dict,)
 
 
 @dataclass(frozen=True)
@@ -197,6 +198,18 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
                   "cat": STRING, "t0": NUMBER, "dur_ms": NUMBER,
                   "step": NUMBER, "reason": STRING, "knob": STRING,
                   "path": STRING},
+    ),
+    # run-health monitor (telemetry/health.py): one verdict per logged
+    # train interval when --health on. ``state`` is ok/degraded/critical
+    # (``state_code`` 0/1/2 — also the offline CLI's exit code and the
+    # Prometheus health_state gauge); every non-ok verdict lists its
+    # attributed ``causes`` with the rolling-window evidence inline
+    "health_status": EventSchema(
+        required={"step": NUMBER, "state": STRING, "state_code": NUMBER},
+        optional={"causes": ARRAY, "evidence": OBJECT,
+                  "window_intervals": NUMBER, "step_s_p50": NUMBER,
+                  "step_s_p95": NUMBER, "step_s_p99": NUMBER,
+                  "step_s_trend": NUMBER, "data_wait_frac": NUMBER},
     ),
     # cross-run regression sentinel (analysis/regression_sentinel.py):
     # the newest bench_history.jsonl record vs a baseline, classified

@@ -122,6 +122,13 @@ class PrometheusTextfileExporter(Exporter):
     absolute estimate). The exposed exchange time stays a gauge
     (``<prefix>_train_exposed_exchange_ms``): it is a level, not a
     volume.
+
+    ``health_status`` records (telemetry/health.py) additionally publish
+    ``<prefix>_health_state`` (the 0/1/2 ok/degraded/critical code) and
+    one ``<prefix>_health_cause_active{cause="..."}`` gauge per cause
+    the monitor has ever attributed — 1 while the cause is named by the
+    latest verdict, 0 once it clears — so dashboards can alert on a
+    specific cause, not just the aggregate state.
     """
 
     # per-event numeric fields that accumulate as *_total counters
@@ -141,6 +148,7 @@ class PrometheusTextfileExporter(Exporter):
         self._gauges: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}
         self._counters: Dict[str, float] = {}
+        self._cause_active: Dict[str, float] = {}
         self._since_write = 0
         self._lock = threading.Lock()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -169,6 +177,23 @@ class PrometheusTextfileExporter(Exporter):
                             f"{_METRIC_CHARS.sub('_', k)}_total")
                     self._counters[name] = (self._counters.get(name, 0.0)
                                             + float(v))
+            if event == "health_status":
+                code = record.get("state_code")
+                if isinstance(code, (int, float)) \
+                        and not isinstance(code, bool):
+                    self._gauges[f"{self.prefix}_health_state"] = \
+                        float(code)
+                causes = record.get("causes")
+                active = {_METRIC_CHARS.sub("_", c)
+                          for c in (causes if isinstance(causes,
+                                                         (list, tuple))
+                                    else ())
+                          if isinstance(c, str)}
+                for c in active:
+                    self._cause_active[c] = 1.0
+                for c in self._cause_active:
+                    if c not in active:
+                        self._cause_active[c] = 0.0
             self._since_write += 1
             if self._since_write >= self.write_every:
                 self._write_locked()
@@ -181,6 +206,10 @@ class PrometheusTextfileExporter(Exporter):
                 f"{self._counts[ev]}\n")
         for name in sorted(self._counters):
             lines.append(f"{name} {self._counters[name]:.10g}\n")
+        for cause in sorted(self._cause_active):
+            lines.append(
+                f'{self.prefix}_health_cause_active{{cause="{cause}"}} '
+                f"{self._cause_active[cause]:.10g}\n")
         for name in sorted(self._gauges):
             lines.append(f"{name} {self._gauges[name]:.10g}\n")
         tmp = f"{self.path}.tmp.{os.getpid()}"

@@ -18,6 +18,8 @@ import json
 import statistics
 from typing import Any, Dict, List, Optional, Sequence
 
+from .health import replay_health
+
 
 def load_events(path: str) -> List[Dict[str, Any]]:
     """Read a JSONL stream tolerantly: undecodable lines are skipped (the
@@ -184,6 +186,16 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "checkpoints": len(by_kind.get("checkpoint", [])),
     }
 
+    # run health (telemetry/health.py): replayed from the raw stream at
+    # the live cadence (one verdict per train interval), so the section
+    # exists even for runs recorded before --health on — and for
+    # live-monitored runs it reproduces the exact verdicts they logged
+    if train:
+        _, health_mon = replay_health(events)
+        hs = health_mon.summary()
+        if hs["verdicts"]:
+            summary["health"] = hs
+
     evals = by_kind.get("eval", [])
     if evals:
         last = evals[-1]
@@ -323,6 +335,22 @@ def format_report(summary: Dict[str, Any]) -> str:
         lines.append(
             f"  last rollback: {lr_.get('reason')} -> step "
             f"{lr_.get('to_step')} (lr_scale {lr_.get('lr_scale')})")
+
+    if "health" in s:
+        h = s["health"]
+        lines.append(f"== run health (worst: {h['worst_state']}, "
+                     f"{h['verdicts']} verdicts) ==")
+        for cause, steps in sorted(h.get("cause_steps", {}).items(),
+                                   key=lambda kv: (-kv[1], kv[0])):
+            lines.append(f"  cause {cause:<22} active ~{steps} step(s)")
+        incidents = h.get("incidents", [])
+        if incidents:
+            for i in incidents:
+                lines.append(
+                    f"  steps {i['start_step']:>6}-{i['end_step']:<6} "
+                    f"{i['state']:<9} {', '.join(i['causes'])}")
+        else:
+            lines.append("  no incidents")
 
     if "eval_last" in s:
         lines.append("== eval (last) ==")

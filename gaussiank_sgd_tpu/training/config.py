@@ -100,6 +100,20 @@ class TrainConfig:
                                             # builds. Render with
                                             # `python -m gaussiank_sgd_tpu.
                                             # telemetry trace`
+    health: str = "off"                     # 'on' = run-health monitor
+                                            # (telemetry/health.py): rolling
+                                            # SLO windows over the event
+                                            # stream, one ok/degraded/
+                                            # critical health_status verdict
+                                            # per log interval with
+                                            # attributed causes; 'off' =
+                                            # stream byte-identical to
+                                            # pre-health builds
+    health_port: Optional[int] = None       # serve live health JSON at
+                                            # http://127.0.0.1:PORT/healthz
+                                            # (+ /metrics); implies
+                                            # health='on'. 0 = ephemeral
+                                            # port (tests)
 
     # numerics
     compute_dtype: str = "bfloat16"         # MXU-native compute
@@ -262,6 +276,15 @@ def add_args(p: argparse.ArgumentParser, suppress_defaults: bool = False) -> Non
                         "on = emit host-phase span records and stamp "
                         "trace_id/span_id on every event; off = stream "
                         "byte-identical to pre-tracing builds")
+    p.add_argument("--health", choices=("off", "on"), default=d.health,
+                   help="run-health monitor (telemetry/health.py): on = "
+                        "one ok/degraded/critical health_status verdict "
+                        "per log interval with attributed causes; off = "
+                        "stream byte-identical to pre-health builds")
+    p.add_argument("--health-port", dest="health_port", type=int,
+                   default=d.health_port,
+                   help="serve live health JSON at /healthz (+ /metrics) "
+                        "on this port; implies --health on; 0 = ephemeral")
     p.add_argument("--compress-warmup-steps", dest="compress_warmup_steps",
                    type=int, default=d.compress_warmup_steps)
     p.add_argument("--fold-lr", dest="fold_lr",

@@ -108,6 +108,19 @@ class ResilienceMonitor:
             for hook in self._anomaly_hooks:
                 hook(reason, step)
 
+    def pre_arm(self, reason: str, step: int) -> None:
+        """Externally arm a rollback, as if a detector fired at ``step``.
+
+        The run-health monitor's hookup (telemetry/health.py,
+        docs/OBSERVABILITY.md "Run health"): a critical verdict for a
+        cause a rewind can actually fix (e.g. runaway EF pressure) is
+        pre-armed here, so the very next log-interval boundary executes
+        the rollback through the normal path — anomaly hooks fire,
+        checkpoints sealed at or after ``step`` are excluded, the
+        rollback budget applies. No-op when an anomaly is already
+        pending (first reason wins, like the internal detectors)."""
+        self._set_pending(reason, step)
+
     def observe(self, step: int, loss: float, skipped: float) -> None:
         p = self.policy
         if skipped > 0:

@@ -51,6 +51,8 @@ from .resilience import (GracefulShutdown, ResilienceMonitor,
                          ResiliencePolicy, TrainingPreempted)
 from ..telemetry import (EventBus, JSONLExporter,
                          PrometheusTextfileExporter, ThroughputTracker)
+from ..telemetry.health import (CRITICAL, PRE_ARM_CAUSES, HealthMonitor,
+                                HealthServer)
 from ..telemetry.profiler import ProfilerSession
 from ..telemetry.tracing import TraceContext
 
@@ -243,6 +245,31 @@ class Trainer:
             # the engine rides the bus as an exporter: its emit() only
             # ingests signals (never publishes — the bus lock is held)
             self.bus.attach(self.engine)
+
+        # ---- run-health monitor (docs/OBSERVABILITY.md "Run health") ----
+        # same opt-in gating as tracing/policy: default 'off' attaches
+        # nothing and publishes nothing, so the stream stays
+        # byte-identical to pre-health builds. The monitor ingests as a
+        # bus exporter; the verdict pass runs on this thread inside
+        # _log_train, which is also the only publish site — and because
+        # the published health_status records flow back through the bus
+        # fan-out, the policy engine's signals pick them up with no extra
+        # wiring (a non-ok state gates exploration, policy/engine.py)
+        self.health: Optional[HealthMonitor] = None
+        self._health_server: Optional[HealthServer] = None
+        if cfg.health == "on" or cfg.health_port is not None:
+            from ..policy import load_roofline_floor
+            self.health = HealthMonitor(
+                floor_ms=load_roofline_floor(cfg.dnn,
+                                             jax.default_backend()),
+                density_target=cfg.density)
+            self.bus.attach(self.health)
+            if cfg.health_port is not None:
+                self._health_server = HealthServer(
+                    self.health, port=cfg.health_port,
+                    prom_path=cfg.prom_textfile).start()
+                self.logger.info("health endpoint: http://127.0.0.1:%d"
+                                 "/healthz", self._health_server.port)
 
         # ---- eval step: shard_map'd sum-reduce over dp ----
         eval_fn = make_eval_fn(self.spec, recurrent=self.recurrent,
@@ -948,6 +975,22 @@ class Trainer:
         aux = jax.device_get(m.aux)
         rec.update({k: float(v) for k, v in aux.items()})
         self.bus.publish(rec)
+        if self.health is not None:
+            # one verdict per published train record — the exact cadence
+            # replay_health reproduces offline, so the live endpoint, the
+            # CLI and the report section agree verdict-for-verdict. The
+            # tick reads only host state already synced above: zero extra
+            # device syncs
+            hrec = self.health.tick(step)
+            self.bus.publish(hrec)
+            if self.monitor is not None \
+                    and hrec["state_code"] >= CRITICAL:
+                for cause in hrec["causes"]:
+                    if cause in PRE_ARM_CAUSES:
+                        # arm the normal rollback path; the boundary
+                        # check right after this log call executes it
+                        self.monitor.pre_arm(f"health:{cause}", step)
+                        break
         if not quiet:
             imgs = self.cfg.global_batch_size / max(rec["step_s"], 1e-9)
             phases = ""
@@ -1060,4 +1103,7 @@ class Trainer:
                 self.trace.end(self._traj_span)
                 self._traj_span = None
             self.trace.uninstall()
+        if self._health_server is not None:
+            self._health_server.close()
+            self._health_server = None
         self.bus.close()
