@@ -1,8 +1,11 @@
 """gklint — JAX-aware static analysis for the TPU training stack.
 
-Six rules enforcing the repo's jit/donation/collective invariants (see
-docs/LINTING.md): host-sync-in-hot-path, recompile-hazard,
-mesh-axis-consistency, donation-check, traced-control-flow, fail-loud.
+Nine AST rules enforcing the repo's jit/donation/collective invariants
+(see docs/LINTING.md): host-sync-in-hot-path, recompile-hazard,
+mesh-axis-consistency, donation-check, traced-control-flow, fail-loud,
+print-in-library, collective-outside-pipeline, lock-discipline — plus
+the v2 program tier (``lint audit``, lint/program_audit.py) that checks
+the jaxpr the source actually builds.
 
 CLI: ``python -m gaussiank_sgd_tpu.lint [--json] [paths...]`` — exits
 nonzero on findings not in the committed baseline. Library entry points:
@@ -13,10 +16,11 @@ nonzero on findings not in the committed baseline. Library entry points:
 from .baseline import (default_baseline_path, load_baseline, split_new,
                        write_baseline)
 from .core import Finding, lint_paths, lint_source
+from .reachability import PackageReachability
 from .rules import ALL_RULES, RULES_BY_NAME, select_rules
 
 __all__ = [
-    "ALL_RULES", "Finding", "RULES_BY_NAME", "default_baseline_path",
-    "lint_paths", "lint_source", "load_baseline", "select_rules",
-    "split_new", "write_baseline",
+    "ALL_RULES", "Finding", "PackageReachability", "RULES_BY_NAME",
+    "default_baseline_path", "lint_paths", "lint_source", "load_baseline",
+    "select_rules", "split_new", "write_baseline",
 ]

@@ -2,12 +2,17 @@
 
     python -m gaussiank_sgd_tpu.lint                  # lint the package
     python -m gaussiank_sgd_tpu.lint --json           # machine output
+    python -m gaussiank_sgd_tpu.lint --changed        # gate changed files
     python -m gaussiank_sgd_tpu.lint --write-baseline # accept current set
     python -m gaussiank_sgd_tpu.lint --list-rules
     python -m gaussiank_sgd_tpu.lint path/to/file.py another/dir
+    python -m gaussiank_sgd_tpu.lint audit [...]      # jaxpr program tier
 
 Exit codes: 0 clean (or all findings baselined), 1 new findings, 2 usage
-error. Pure-AST: runs without initializing jax/TPU.
+error. The AST tier is pure-AST: it runs without initializing jax/TPU.
+The ``audit`` subcommand is the v2 program tier (lint/program_audit.py);
+it traces the jitted step on the CPU backend, so it DOES import jax — its
+flags are documented in ``... lint audit --help``.
 """
 
 from __future__ import annotations
@@ -15,8 +20,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import Any, Dict, List, Optional, Set
 
 from .baseline import (default_baseline_path, load_baseline, split_new,
                        write_baseline)
@@ -28,7 +34,28 @@ def _default_paths() -> List[str]:
     return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
 
 
+def _changed_py_files(repo_root: str) -> Optional[Set[str]]:
+    """Repo-root-relative ``.py`` paths changed vs HEAD (tracked diffs +
+    untracked files); None when git is unavailable or this is no repo."""
+    changed: Set[str] = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD", "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, cwd=repo_root, capture_output=True,
+                                 text=True, check=True, timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        changed |= {os.path.normpath(ln.strip())
+                    for ln in res.stdout.splitlines()
+                    if ln.strip().endswith(".py")}
+    return changed
+
+
 def main(argv: Optional[List[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "audit":
+        return _audit_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m gaussiank_sgd_tpu.lint",
         description="JAX-aware static analysis for the TPU training stack")
@@ -44,6 +71,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="ignore the baseline: every finding gates")
     ap.add_argument("--write-baseline", action="store_true",
                     help="accept the current findings as the new baseline")
+    ap.add_argument("--changed", action="store_true",
+                    help="report/gate only findings in files changed vs "
+                         "git HEAD (the whole package is still analysed "
+                         "so cross-module reachability stays exact)")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -58,6 +89,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: {e.args[0]}", file=sys.stderr)
         return 2
 
+    if args.changed and args.paths:
+        print("error: --changed scopes the default package lint; it cannot "
+              "be combined with explicit paths", file=sys.stderr)
+        return 2
+
     paths = args.paths or _default_paths()
     # findings are repo-root-relative when linting the installed package so
     # the committed baseline matches from any cwd
@@ -65,6 +101,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     findings = lint_paths(paths, rules=rules,
                           rel_to=pkg_parent if not args.paths else None)
+
+    if args.changed:
+        changed = _changed_py_files(pkg_parent)
+        if changed is None:
+            print("error: --changed needs git and a work tree at "
+                  f"{pkg_parent}", file=sys.stderr)
+            return 2
+        findings = [f for f in findings
+                    if os.path.normpath(f.path) in changed]
 
     baseline_path = args.baseline or default_baseline_path()
     if args.write_baseline:
@@ -91,12 +136,126 @@ def main(argv: Optional[List[str]] = None) -> int:
         summary = (f"gklint: {len(new)} new finding(s), "
                    f"{len(old)} baselined, "
                    f"{len(ALL_RULES) if not args.rules else len(rules)} "
-                   f"rule(s)")
+                   f"rule(s)"
+                   + (" [changed files only]" if args.changed else ""))
         print(summary)
         if new:
             print("  fix, suppress with `# gklint: disable=<rule>`, or "
                   "accept via --write-baseline (docs/LINTING.md)")
     return 1 if new else 0
+
+
+def _audit_human_report(report: Dict[str, Any], fp_violations: List[str],
+                        warnings: List[str]) -> None:
+    for name, arm in report["arms"].items():
+        if "error" in arm:
+            print(f"{name:38s} ERROR {arm['error']}")
+            continue
+        inv = arm["collectives"]
+        coll = " ".join(
+            f"{k}={v['total']}({v['in_scan']} in-scan)"
+            for k, v in sorted(inv.items()))
+        print(f"{name:38s} {arm['fingerprint']}  "
+              f"wire={arm['wire_format']:8s} overlap={arm['overlap']:9s} "
+              f"donate={arm['donated']}/{arm['donatable']}  {coll}")
+    for ident in report["identities"]:
+        status = "ok" if ident["equal"] else "BROKEN"
+        print(f"identity {ident['group']}: {status} "
+              f"({', '.join(ident['arms'])})")
+    for w in warnings:
+        print(f"warning: {w}")
+    for v in report["violations"] + fp_violations:
+        print(f"VIOLATION: {v}")
+    n_ok = sum(1 for a in report["arms"].values() if "error" not in a)
+    print(f"gklint audit: {n_ok}/{len(report['arms'])} arm(s) traced, "
+          f"{len(report['violations']) + len(fp_violations)} violation(s), "
+          f"jax {report['jax_version']}")
+
+
+def _audit_main(argv: List[str]) -> int:
+    # deferred import: the program tier is the only part of the lint CLI
+    # that touches jax, and only once `audit` is actually requested
+    from .program_audit import (ARMS, compare_programs,
+                                default_programs_path, load_programs,
+                                programs_snapshot, run_audit)
+    ap = argparse.ArgumentParser(
+        prog="python -m gaussiank_sgd_tpu.lint audit",
+        description="jaxpr-level program contracts for the jitted step "
+                    "(traces on the CPU backend; executes nothing)")
+    ap.add_argument("--programs", default=None,
+                    help="committed fingerprint file (default: "
+                         "<repo>/.gklint-programs.json)")
+    ap.add_argument("--write-programs", action="store_true",
+                    help="re-baseline: write current fingerprints to the "
+                         "programs file")
+    ap.add_argument("--arms", default=None,
+                    help="comma-separated subset of config arms")
+    ap.add_argument("--list-arms", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the full report as JSON")
+    ap.add_argument("-o", "--out", default=None,
+                    help="also write the full report JSON here (the CI / "
+                         "telemetry-join artifact)")
+    ap.add_argument("--devices", type=int, default=2,
+                    help="virtual CPU mesh width (default 2; committed "
+                         "fingerprints are generated at 2)")
+    args = ap.parse_args(argv)
+
+    if args.list_arms:
+        for name, spec in ARMS.items():
+            exp = spec.get("expect", {})
+            tag = " [dense]" if spec.get("dense") else ""
+            ident = spec.get("identity")
+            itag = f" identity={ident}" if ident else ""
+            print(f"{name:38s} wire={exp.get('wire_format', '?'):8s} "
+                  f"overlap={exp.get('overlap', '?'):9s}{tag}{itag}")
+        return 0
+
+    arm_names = ([a.strip() for a in args.arms.split(",") if a.strip()]
+                 if args.arms else None)
+    try:
+        report = run_audit(arm_names, mesh_devices=args.devices)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+    except RuntimeError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    programs_path = args.programs or default_programs_path()
+    if args.write_programs:
+        snap = programs_snapshot(report)
+        with open(programs_path, "w", encoding="utf-8") as fh:
+            json.dump(snap, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"gklint audit: wrote {len(snap['fingerprints'])} program "
+              f"fingerprint(s) to {programs_path}")
+        # structural violations still gate a re-baseline run
+        fp_violations: List[str] = []
+        warnings: List[str] = []
+    else:
+        baseline = load_programs(programs_path)
+        if baseline is None:
+            fp_violations = [
+                f"no committed programs file at {programs_path} — generate "
+                f"one with --write-programs and commit it"]
+            warnings = []
+        else:
+            fp_violations, warnings = compare_programs(
+                report, baseline, partial=arm_names is not None)
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    if args.as_json:
+        print(json.dumps({**report,
+                          "fingerprint_violations": fp_violations,
+                          "warnings": warnings}, indent=2, sort_keys=True))
+    else:
+        _audit_human_report(report, fp_violations, warnings)
+    return 1 if (report["violations"] or fp_violations) else 0
 
 
 if __name__ == "__main__":

@@ -53,6 +53,7 @@ class Finding:
     col: int
     message: str
     source_line: str = ""
+    end_line: int = 0
 
     @property
     def fingerprint(self) -> str:
@@ -63,7 +64,9 @@ class Finding:
     def to_json(self) -> Dict[str, object]:
         return {
             "rule": self.rule, "severity": self.severity, "path": self.path,
-            "line": self.line, "col": self.col, "message": self.message,
+            "line": self.line, "col": self.col,
+            "end_line": self.end_line or self.line,
+            "message": self.message,
             "source": self.source_line.strip(),
             "fingerprint": self.fingerprint,
         }
@@ -113,7 +116,8 @@ class ModuleCtx:
     jit-reachability, the known mesh-axis vocabulary, and suppression maps."""
 
     def __init__(self, path: str, source: str,
-                 known_axes: Optional[Set[str]] = None):
+                 known_axes: Optional[Set[str]] = None,
+                 extra_roots: Iterable[str] = ()):
         self.path = path
         self.source = source
         self.lines = source.splitlines()
@@ -124,7 +128,7 @@ class ModuleCtx:
         for parent in ast.walk(self.tree):
             for child in ast.iter_child_nodes(parent):
                 child._gklint_parent = parent  # type: ignore[attr-defined]
-        self.reach = JitReachability(self.tree)
+        self.reach = JitReachability(self.tree, extra_roots=extra_roots)
 
     def parent(self, node: ast.AST) -> Optional[ast.AST]:
         return getattr(node, "_gklint_parent", None)
@@ -146,7 +150,8 @@ class ModuleCtx:
         return Finding(rule=rule, severity=severity, path=self.path,
                        line=getattr(node, "lineno", 0),
                        col=getattr(node, "col_offset", 0) + 1,
-                       message=message, source_line=self.src(node))
+                       message=message, source_line=self.src(node),
+                       end_line=getattr(node, "end_lineno", 0) or 0)
 
     def is_suppressed(self, f: Finding) -> bool:
         if {f.rule, "*"} & self.suppressed_file:
@@ -172,10 +177,17 @@ def iter_py_files(paths: Sequence[str],
 
 
 def lint_source(source: str, path: str = "<string>", rules=None,
-                known_axes: Optional[Set[str]] = None) -> List[Finding]:
-    """Lint one source string (the test/fixture entry point)."""
+                known_axes: Optional[Set[str]] = None,
+                extra_roots: Iterable[str] = ()) -> List[Finding]:
+    """Lint one source string (the test/fixture entry point).
+
+    ``extra_roots`` seeds cross-module jit-reachability (function names in
+    this module that a traced caller elsewhere references); ``lint_paths``
+    computes it from :class:`~.reachability.PackageReachability`.
+    """
     from .rules import ALL_RULES
-    ctx = ModuleCtx(path, source, known_axes=known_axes)
+    ctx = ModuleCtx(path, source, known_axes=known_axes,
+                    extra_roots=extra_roots)
     found: List[Finding] = []
     for rule in (rules if rules is not None else ALL_RULES):
         found.extend(f for f in rule.check(ctx) if not ctx.is_suppressed(f))
@@ -185,26 +197,39 @@ def lint_source(source: str, path: str = "<string>", rules=None,
 
 def lint_paths(paths: Sequence[str], rules=None,
                known_axes: Optional[Set[str]] = None,
-               rel_to: Optional[str] = None) -> List[Finding]:
+               rel_to: Optional[str] = None,
+               cross_module: bool = True) -> List[Finding]:
     """Lint every ``.py`` under ``paths``; paths in findings are made
     relative to ``rel_to`` (default: cwd) so baselines are machine-portable.
+
+    With ``cross_module`` (the default) a whole-package reachability
+    fixpoint runs first, so reachability-gated rules see helpers that are
+    only traced via imports from another module. Still pure-AST: nothing
+    is imported or executed.
     """
+    from .reachability import PackageReachability
     from .rules import ALL_RULES, discover_known_axes
     files = iter_py_files(paths)
     if known_axes is None:
         known_axes = discover_known_axes(files)
     base = os.path.abspath(rel_to or os.getcwd())
-    found: List[Finding] = []
+    sources: List[tuple] = []
     for fpath in files:
         try:
             with open(fpath, "r", encoding="utf-8") as fh:
-                source = fh.read()
+                sources.append((fpath, fh.read()))
         except (OSError, UnicodeDecodeError):
             continue
+    pkg_reach = PackageReachability(sources) if cross_module else None
+    found: List[Finding] = []
+    for fpath, source in sources:
         rel = os.path.relpath(os.path.abspath(fpath), base)
+        extra = (pkg_reach.extra_roots_for(fpath) if pkg_reach is not None
+                 else frozenset())
         try:
             found.extend(lint_source(source, path=rel, rules=rules,
-                                     known_axes=known_axes))
+                                     known_axes=known_axes,
+                                     extra_roots=extra))
         except SyntaxError as e:
             found.append(Finding(
                 rule="parse-error", severity="error", path=rel,

@@ -1,4 +1,4 @@
-"""Approximate jit-reachability over one module's AST.
+"""Approximate jit-reachability: per-module analysis + package fixpoint.
 
 A function body is "traced" (executes under jit staging) when the function
 is (a) decorated with ``jax.jit`` / ``functools.partial(jax.jit, ...)``,
@@ -8,17 +8,31 @@ of its own parameters into a jit call, like trainstep's ``_smap``/``_wrap``),
 or (c) referenced from an already-traced body (covers helpers and functions
 handed to ``lax.scan`` / ``lax.cond`` / ``jax.vmap`` from traced code).
 
-This is intentionally a per-module, name-based approximation: it cannot see
-cross-module calls, and it over-approximates by treating ANY name reference
-from traced code as a call. Both error directions are handled by the
-suppression/baseline workflow; the point is catching the common hazards
-mechanically, not a sound interprocedural analysis.
+:class:`JitReachability` is the per-module, name-based approximation. On
+its own it cannot see cross-module calls, and it over-approximates by
+treating ANY name reference from traced code as a call. Both error
+directions are handled by the suppression/baseline workflow; the point is
+catching the common hazards mechanically, not a sound interprocedural
+analysis.
+
+:class:`PackageReachability` (gklint v2) closes the cross-module gap
+without importing anything: it resolves the package's import graph from
+the ASTs alone (``import a.b as m`` / ``from .x import f`` / relative
+levels / ``__init__`` re-exports) and runs a fixpoint — a symbol referenced
+from one module's traced code seeds the defining module's reachability as
+an *extra root*, which can make further cross-module references traced,
+until nothing changes. A helper in ``ops/`` called from the jitted step in
+``parallel/trainstep.py`` is then "in traced code" for every reachability-
+gated rule (host-sync-in-hot-path, traced-control-flow,
+collective-outside-pipeline).
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set, Union
+import os
+from typing import (Dict, FrozenSet, Iterable, List, Optional, Sequence, Set,
+                    Tuple, Union)
 
 FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
 
@@ -46,8 +60,12 @@ def _partial_of_jit(call: ast.Call) -> bool:
 
 
 class JitReachability:
-    def __init__(self, tree: ast.Module):
+    def __init__(self, tree: ast.Module,
+                 extra_roots: Iterable[str] = ()):
         self.tree = tree
+        #: function names traced because a CALLER IN ANOTHER MODULE
+        #: references them from traced code (fed by PackageReachability)
+        self.extra_roots: FrozenSet[str] = frozenset(extra_roots)
         self._funcs: List[FuncNode] = []
         self._by_name: Dict[str, List[FuncNode]] = {}
         self._enclosing: Dict[int, Optional[FuncNode]] = {}
@@ -110,6 +128,9 @@ class JitReachability:
         return wrappers
 
     def _seed_roots(self) -> None:
+        for name in self.extra_roots:
+            for fn in self._by_name.get(name, []):
+                self.reachable.add(id(fn))
         entry_names = JIT_ENTRY_NAMES | self._wrappers
         for node in ast.walk(self.tree):
             # decorator forms
@@ -167,3 +188,220 @@ class JitReachability:
                 return True
             cur = self.enclosing_function(cur)
         return False
+
+
+# ---------------------------------------------------------------------------
+# whole-package fixpoint (gklint v2)
+# ---------------------------------------------------------------------------
+
+def module_name_for(path: str) -> str:
+    """Dotted module name from a file path, walking up ``__init__.py`` dirs.
+
+    ``pkg/sub/mod.py`` -> ``pkg.sub.mod``; ``pkg/__init__.py`` -> ``pkg``;
+    a file in a plain (non-package) directory is just its stem, which is
+    exactly how a flat test-fixture directory imports its siblings.
+    """
+    path = os.path.abspath(path)
+    base = os.path.splitext(os.path.basename(path))[0]
+    parts: List[str] = [] if base == "__init__" else [base]
+    d = os.path.dirname(path)
+    while os.path.isfile(os.path.join(d, "__init__.py")):
+        parts.insert(0, os.path.basename(d))
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return ".".join(parts) or base
+
+
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """``a.b.c`` -> ['a', 'b', 'c']; None when not rooted at a plain Name."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+class _ModuleInfo:
+    def __init__(self, path: str, modname: str, tree: ast.Module):
+        self.path = path
+        self.modname = modname
+        self.tree = tree
+        self.is_pkg = os.path.basename(path) == "__init__.py"
+        if self.is_pkg:
+            self.package = modname
+        else:
+            self.package = modname.rsplit(".", 1)[0] if "." in modname else ""
+        #: local name -> dotted module it aliases (``import a.b as m``)
+        self.mod_alias: Dict[str, str] = {}
+        #: local name -> (dotted module, symbol)  (``from .x import f as g``)
+        self.sym_alias: Dict[str, Tuple[str, str]] = {}
+        #: function names defined anywhere in this module
+        self.function_names: Set[str] = {
+            n.name for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        #: cross-module roots discovered by the fixpoint
+        self.extra_roots: Set[str] = set()
+        self.reach: Optional[JitReachability] = None
+
+
+class PackageReachability:
+    """Cross-module jit-reachability over a set of files, import-free.
+
+    Feed it every ``(path, source)`` being linted; query
+    :meth:`extra_roots_for` per file and hand the result to
+    :class:`JitReachability` (via ``ModuleCtx``) so reachability-gated
+    rules see through module boundaries. Files that fail to parse are
+    skipped (the per-file lint reports the parse error).
+    """
+
+    def __init__(self, files: Sequence[Tuple[str, str]]):
+        self._mods: Dict[str, _ModuleInfo] = {}
+        self._by_path: Dict[str, _ModuleInfo] = {}
+        for path, source in files:
+            try:
+                tree = ast.parse(source, filename=path)
+            except (SyntaxError, ValueError):
+                continue
+            info = _ModuleInfo(os.path.abspath(path),
+                               module_name_for(path), tree)
+            self._mods[info.modname] = info
+            self._by_path[info.path] = info
+        for m in self._mods.values():
+            self._build_imports(m)
+        self._fixpoint()
+
+    # -- queries -----------------------------------------------------------
+    def extra_roots_for(self, path: str) -> FrozenSet[str]:
+        m = self._by_path.get(os.path.abspath(path))
+        return frozenset(m.extra_roots) if m else frozenset()
+
+    # -- import resolution -------------------------------------------------
+    def _build_imports(self, m: _ModuleInfo) -> None:
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        m.mod_alias[alias.asname] = alias.name
+                    else:
+                        root = alias.name.split(".")[0]
+                        m.mod_alias[root] = root
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_from_base(m, node)
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    dotted = f"{base}.{alias.name}" if base else alias.name
+                    if dotted in self._mods:
+                        m.mod_alias[local] = dotted
+                    else:
+                        m.sym_alias[local] = (base, alias.name)
+
+    @staticmethod
+    def _resolve_from_base(m: _ModuleInfo, node: ast.ImportFrom) -> str:
+        if node.level == 0:
+            return node.module or ""
+        pkg_parts = m.package.split(".") if m.package else []
+        keep = pkg_parts[:max(0, len(pkg_parts) - (node.level - 1))]
+        tail = node.module.split(".") if node.module else []
+        return ".".join(keep + tail)
+
+    def _resolve_ref(self, m: _ModuleInfo,
+                     node: ast.AST) -> Optional[Tuple[str, str]]:
+        """(defining module, symbol) for a Name/Attribute reference, when
+        it resolves to a module in the linted set; None otherwise."""
+        if isinstance(node, ast.Name):
+            tgt = m.sym_alias.get(node.id)
+            if tgt and tgt[0] in self._mods:
+                return tgt
+            return None
+        if isinstance(node, ast.Attribute):
+            parts = _attr_chain(node)
+            if not parts:
+                return None
+            root = parts[0]
+            if root in m.mod_alias:
+                parts = m.mod_alias[root].split(".") + parts[1:]
+            elif root in m.sym_alias:
+                base, sym = m.sym_alias[root]
+                parts = ((base.split(".") if base else [])
+                         + [sym] + parts[1:])
+            else:
+                return None
+            for i in range(len(parts) - 1, 0, -1):
+                prefix = ".".join(parts[:i])
+                if prefix in self._mods:
+                    return (prefix, parts[i])
+            return None
+        return None
+
+    def _resolve_export(self, modname: str, sym: str,
+                        seen: Set[Tuple[str, str]]) -> \
+            Optional[Tuple[str, str]]:
+        """Follow ``__init__``-style re-export chains to the module that
+        actually defines ``sym`` as a function."""
+        if (modname, sym) in seen:
+            return None
+        seen.add((modname, sym))
+        t = self._mods.get(modname)
+        if t is None:
+            return None
+        if sym in t.function_names:
+            return (modname, sym)
+        if sym in t.sym_alias:
+            base, sym2 = t.sym_alias[sym]
+            return self._resolve_export(base, sym2, seen)
+        return None
+
+    # -- fixpoint ----------------------------------------------------------
+    def _traced_refs(self, m: _ModuleInfo) -> Set[Tuple[str, str]]:
+        refs: Set[Tuple[str, str]] = set()
+        reach = m.reach
+        if reach is None:  # _fixpoint builds reach before calling this
+            return refs
+        entry_names = JIT_ENTRY_NAMES | reach._wrappers
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call):
+                # imported function handed straight into a jit entry (or a
+                # local jit-wrapper): traced regardless of lexical context
+                target = _callee_name(node.func)
+                if target in entry_names or _partial_of_jit(node):
+                    args = (node.args[1:] if _partial_of_jit(node)
+                            else node.args)
+                    for arg in args:
+                        r = self._resolve_ref(m, arg)
+                        if r:
+                            refs.add(r)
+            if (isinstance(node, (ast.Name, ast.Attribute))
+                    and isinstance(getattr(node, "ctx", None), ast.Load)
+                    and reach.in_traced_code(node)):
+                r = self._resolve_ref(m, node)
+                if r:
+                    refs.add(r)
+        return refs
+
+    def _fixpoint(self) -> None:
+        pending = set(self._mods)
+        # bounded by total defined-function count; in practice 2-3 rounds
+        while pending:
+            for name in pending:
+                m = self._mods[name]
+                m.reach = JitReachability(m.tree, extra_roots=m.extra_roots)
+            pending = set()
+            for m in self._mods.values():
+                for tmod, sym in self._traced_refs(m):
+                    resolved = self._resolve_export(tmod, sym, set())
+                    if resolved is None:
+                        continue
+                    rmod, rsym = resolved
+                    t = self._mods[rmod]
+                    if rsym not in t.extra_roots and t is not m:
+                        t.extra_roots.add(rsym)
+                        pending.add(rmod)

@@ -10,8 +10,8 @@ from __future__ import annotations
 import os
 from typing import List, Optional, Sequence, Set
 
-from . import (control_flow, donation, fail_loud, host_sync, mesh_axes,
-               pipeline_funnel, print_in_library, recompile)
+from . import (control_flow, donation, fail_loud, host_sync, lock_discipline,
+               mesh_axes, pipeline_funnel, print_in_library, recompile)
 
 ALL_RULES = [
     host_sync.Rule(),
@@ -22,6 +22,7 @@ ALL_RULES = [
     fail_loud.Rule(),
     print_in_library.Rule(),
     pipeline_funnel.Rule(),
+    lock_discipline.Rule(),
 ]
 
 RULES_BY_NAME = {r.name: r for r in ALL_RULES}

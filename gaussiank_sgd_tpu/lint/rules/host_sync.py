@@ -76,8 +76,13 @@ class Rule:
                     continue
                 arg = node.args[0]
                 # float(2), float(cfg.lr), float(x.shape[0]) are trace-time
-                # static; only flag when the operand can plausibly be traced
+                # static; only flag when the operand can plausibly be traced.
+                # math.* results are host floats already — a tracer operand
+                # would have failed inside the math call itself
                 if isinstance(arg, ast.Constant) or _mentions_shape(arg):
+                    continue
+                if (isinstance(arg, ast.Call)
+                        and _attr_root(arg.func) == "math"):
                     continue
                 yield ctx.finding(
                     NAME, SEVERITY, node,

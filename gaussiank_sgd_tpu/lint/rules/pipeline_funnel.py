@@ -68,6 +68,7 @@ class Rule:
             yield Finding(
                 rule=self.name, severity=self.severity, path=ctx.path,
                 line=node.lineno, col=node.col_offset,
+                end_line=getattr(node, "end_lineno", 0) or 0,
                 message=(f"payload collective {fname}() issued outside "
                          f"the sanctioned pipeline funnels "
                          f"({', '.join(sorted(_SANCTIONED_FUNNELS))}): "

@@ -2,6 +2,8 @@
 
     python -m gaussiank_sgd_tpu.telemetry report run.jsonl        # summary
     python -m gaussiank_sgd_tpu.telemetry report run.jsonl --json
+    python -m gaussiank_sgd_tpu.telemetry report run.jsonl \
+        --audit audit.json   # join the run to its program fingerprint
     python -m gaussiank_sgd_tpu.telemetry validate run.jsonl      # schema
     python -m gaussiank_sgd_tpu.telemetry validate run.jsonl --strict
     python -m gaussiank_sgd_tpu.telemetry trace run.jsonl -o trace.json
@@ -47,6 +49,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     rp.add_argument("path", help="metrics.jsonl / events file")
     rp.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable summary")
+    rp.add_argument("--audit", default=None,
+                    help="program-audit artifact (python -m "
+                         "gaussiank_sgd_tpu.lint audit -o FILE) to join: "
+                         "the report then names the compiled-program "
+                         "fingerprint matching this run's compressor/"
+                         "wire/overlap key and the git rev it was "
+                         "certified at")
 
     vp = sub.add_parser("validate", help="schema-check an event stream")
     vp.add_argument("path")
@@ -107,7 +116,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"error: no telemetry records in {args.path}",
                       file=sys.stderr)
                 return 1
-            summary = summarize(events)
+            audit = None
+            if args.audit:
+                try:
+                    with open(args.audit, "r", encoding="utf-8") as fh:
+                        audit = json.load(fh)
+                except (OSError, ValueError) as e:
+                    print(f"error: cannot read audit artifact "
+                          f"{args.audit}: {e}", file=sys.stderr)
+                    return 2
+            summary = summarize(events, audit=audit)
             print(json.dumps(summary, indent=2, default=float)
                   if args.as_json else format_report(summary))
             return 0

@@ -382,10 +382,10 @@ class HealthMonitor:
                 rec["step_s_trend"] = round(trend_ratio, 4)
             if n:
                 rec["data_wait_frac"] = round(frac, 4)
-            self._account(rec)
+            self._account_locked(rec)
             return rec
 
-    def _account(self, rec: Dict[str, Any]) -> None:
+    def _account_locked(self, rec: Dict[str, Any]) -> None:
         """Incident + time-in-state bookkeeping (lock held)."""
         step = rec["step"]
         state = rec["state_code"]

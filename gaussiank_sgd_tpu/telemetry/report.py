@@ -49,9 +49,51 @@ def _collect(records: List[Dict[str, Any]], key: str) -> List[float]:
             and not isinstance(r.get(key), bool)]
 
 
-def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+def _join_program_audit(audit: Dict[str, Any], cfg: Dict[str, Any],
+                        train: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Join a run's recorded program key (compressor + wire_format +
+    overlap from the stream) to the gklint program-audit artifact
+    (``... lint audit -o audit.json``), so the report names the exact
+    compiled-program fingerprint the run executed and the git rev the
+    audit certified it at."""
+    sel = cfg.get("compressor")
+    wire = next((r.get("wire_format") for r in reversed(train)
+                 if isinstance(r.get("wire_format"), str)), None)
+    ovl = next((r.get("overlap") for r in reversed(train)
+                if isinstance(r.get("overlap"), str)), None)
+    matches: List[Dict[str, Any]] = []
+    # a stream that recorded none of the key fields matches nothing —
+    # "every arm matched" would misread as a certification
+    if sel is not None or wire is not None or ovl is not None:
+        for name, arm in sorted((audit.get("arms") or {}).items()):
+            if "fingerprint" not in arm:
+                continue
+            acfg = arm.get("config", {})
+            if acfg.get("dense"):
+                continue
+            if wire is not None and arm.get("wire_format") != wire:
+                continue
+            if ovl is not None and arm.get("overlap") != ovl:
+                continue
+            if sel is not None and acfg.get("selector") not in (None, sel):
+                continue
+            matches.append({"arm": name,
+                            "fingerprint": arm["fingerprint"]})
+    return {
+        "audit_git_rev": audit.get("git_rev"),
+        "audit_jax_version": audit.get("jax_version"),
+        "audit_ok": audit.get("ok"),
+        "run_program_key": {"compressor": sel, "wire_format": wire,
+                            "overlap": ovl},
+        "matched_arms": matches,
+    }
+
+
+def summarize(events: List[Dict[str, Any]],
+              audit: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Aggregate one run's event list into the report dict (see module
-    docstring for the sections)."""
+    docstring for the sections). ``audit`` is an optional parsed program-
+    audit artifact to join against (``--audit``)."""
     by_kind: Dict[str, List[Dict[str, Any]]] = {}
     for e in events:
         by_kind.setdefault(e["event"], []).append(e)
@@ -207,6 +249,9 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         summary["profile"] = [
             {k: p.get(k) for k in ("action", "step", "logdir")}
             for p in profiles]
+
+    if audit is not None:
+        summary["program_audit"] = _join_program_audit(audit, cfg, train)
     return summary
 
 
@@ -351,6 +396,26 @@ def format_report(summary: Dict[str, Any]) -> str:
                     f"{i['state']:<9} {', '.join(i['causes'])}")
         else:
             lines.append("  no incidents")
+
+    if "program_audit" in s:
+        pa = s["program_audit"]
+        key = pa["run_program_key"]
+        lines.append("== program audit join ==")
+        lines.append(
+            f"  audit @ git {pa.get('audit_git_rev') or '?'} "
+            f"(jax {pa.get('audit_jax_version') or '?'}, "
+            f"{'clean' if pa.get('audit_ok') else 'VIOLATIONS'})")
+        lines.append(
+            f"  run program key: compressor={key.get('compressor') or '?'} "
+            f"wire={key.get('wire_format') or '?'} "
+            f"overlap={key.get('overlap') or '?'}")
+        if pa["matched_arms"]:
+            for m in pa["matched_arms"]:
+                lines.append(f"  matched arm {m['arm']:<38} "
+                             f"fingerprint {m['fingerprint']}")
+        else:
+            lines.append("  no audited arm matches this run's program key "
+                         "(config outside the audited matrix)")
 
     if "eval_last" in s:
         lines.append("== eval (last) ==")

@@ -85,18 +85,18 @@ class ThroughputTracker:
         with self._lock:
             return len(self._samples)
 
-    # -- unlocked internals (callers hold self._lock) ---------------------
-    def _total_seconds(self) -> float:
+    # -- *_locked internals (callers hold self._lock) ---------------------
+    def _total_seconds_locked(self) -> float:
         return sum(s for _, s, _ in self._samples)
 
-    def _examples_per_s(self) -> Optional[float]:
-        secs = self._total_seconds()
+    def _examples_per_s_locked(self) -> Optional[float]:
+        secs = self._total_seconds_locked()
         if not self._samples or secs <= 0:
             return None
         return sum(e for e, _, _ in self._samples) / secs
 
-    def _steps_per_s(self) -> Optional[float]:
-        secs = self._total_seconds()
+    def _steps_per_s_locked(self) -> Optional[float]:
+        secs = self._total_seconds_locked()
         if not self._samples or secs <= 0:
             return None
         useful = sum(1 for _, _, sk in self._samples if not sk)
@@ -113,7 +113,7 @@ class ThroughputTracker:
     @property
     def total_seconds(self) -> float:
         with self._lock:
-            return self._total_seconds()
+            return self._total_seconds_locked()
 
     @property
     def skipped_in_window(self) -> int:
@@ -125,13 +125,13 @@ class ThroughputTracker:
         """Useful examples per wall-clock second over the window; None
         until a sample with nonzero time exists."""
         with self._lock:
-            return self._examples_per_s()
+            return self._examples_per_s_locked()
 
     @property
     def steps_per_s(self) -> Optional[float]:
         """UNSKIPPED steps per second (skips burn time, produce nothing)."""
         with self._lock:
-            return self._steps_per_s()
+            return self._steps_per_s_locked()
 
     @property
     def step_s_ema(self) -> Optional[float]:
@@ -145,7 +145,7 @@ class ThroughputTracker:
         (elapsed * peak). None when FLOPs/peak are unknown (CPU) or the
         window is empty."""
         with self._lock:
-            return self._mfu(self._steps_per_s(), flops_per_step,
+            return self._mfu(self._steps_per_s_locked(), flops_per_step,
                              peak_flops)
 
     def signals(self, flops_per_step: Optional[float] = None,
@@ -154,14 +154,14 @@ class ThroughputTracker:
         read under one lock acquisition, so the policy engine and the
         report CLI see the same numbers a log line was stamped from."""
         with self._lock:
-            sps = self._steps_per_s()
+            sps = self._steps_per_s_locked()
             return ThroughputSignals(
                 window_steps=len(self._samples),
                 skipped_in_window=sum(
                     1 for _, _, sk in self._samples if sk),
-                total_seconds=self._total_seconds(),
+                total_seconds=self._total_seconds_locked(),
                 step_s_ema=self._step_ema,
-                examples_per_s=self._examples_per_s(),
+                examples_per_s=self._examples_per_s_locked(),
                 steps_per_s=sps,
                 mfu=self._mfu(sps, flops_per_step, peak_flops),
             )

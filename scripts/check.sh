@@ -1,10 +1,11 @@
 #!/usr/bin/env bash
-# The repo's check gate (docs/LINTING.md): gklint -> typecheck -> tier-1
-# tests, in cheap-to-expensive order so CI fails fast on style/static
-# errors before burning 12 minutes of pytest.
+# The repo's check gate (docs/LINTING.md): gklint -> typecheck -> program
+# audit -> tier-1 tests, in cheap-to-expensive order so CI fails fast on
+# style/static errors before burning 12 minutes of pytest.
 #
 #   scripts/check.sh             # everything
-#   scripts/check.sh --no-tests  # lint + typecheck only (pre-commit speed)
+#   scripts/check.sh --no-tests  # lint (changed-files gate) + typecheck
+#                                # only (pre-commit speed)
 #
 # Exit nonzero on the first failing stage.
 set -euo pipefail
@@ -16,9 +17,15 @@ if [[ "${1:-}" == "--no-tests" ]]; then
 fi
 
 echo "== gklint (JAX-aware static analysis) =="
-# pure-AST: no device/platform init. --json kept for CI log scraping;
-# exits 1 on findings not in the committed .gklint-baseline.json
-python -m gaussiank_sgd_tpu.lint
+# pure-AST: no device/platform init. Exits 1 on findings not in the
+# committed .gklint-baseline.json. The pre-commit path gates only files
+# changed vs HEAD (the whole package is still analysed, so cross-module
+# reachability stays exact); full mode gates everything.
+if [[ "${RUN_TESTS}" == "1" ]]; then
+  python -m gaussiank_sgd_tpu.lint
+else
+  python -m gaussiank_sgd_tpu.lint --changed
+fi
 
 echo "== typecheck (mypy) =="
 if command -v mypy >/dev/null 2>&1; then
@@ -30,6 +37,18 @@ else
 fi
 
 if [[ "${RUN_TESTS}" == "1" ]]; then
+  echo "== gklint audit (jaxpr program contracts) =="
+  # the v2 program tier (docs/LINTING.md "v2"): abstract-traces the jitted
+  # step for the build-config matrix on the CPU backend — no execution —
+  # and checks the committed .gklint-programs.json fingerprints plus the
+  # structural contracts (no host callbacks, donation, collective
+  # placement). Needs jax; skipped where the toolchain isn't baked in.
+  if env JAX_PLATFORMS=cpu python -c "import jax" >/dev/null 2>&1; then
+    env JAX_PLATFORMS=cpu python -m gaussiank_sgd_tpu.lint audit
+  else
+    echo "jax not importable — skipping program audit (CI runs it)"
+  fi
+
   echo "== tier-1 tests =="
   # ROADMAP.md tier-1 verify command (870s budget, 8-device virtual CPU)
   rm -f /tmp/_t1.log

@@ -352,6 +352,66 @@ def test_pipeline_funnel_scoped_to_parallel_dir():
     assert found == []
 
 
+# ---------------------------------------------------------- lock-discipline
+
+def test_lock_discipline_flags_unlocked_access_to_guarded_attr():
+    found = run("""
+        import threading
+
+        class Meter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def add(self):
+                with self._lock:
+                    self._n += 1
+
+            def peek(self):
+                return self._n        # guarded elsewhere, no lock here
+
+            def _bump_locked(self):
+                self._n += 2          # *_locked convention: caller holds it
+        """, rule="lock-discipline", path="telemetry/fixture.py")
+    assert len(found) == 1
+    assert found[0].severity == "warning"
+    assert "self._n" in found[0].message and "_locked" in found[0].message
+
+
+def test_lock_discipline_scoped_and_quiet_on_unguarded_state():
+    guarded_elsewhere = """
+        import threading
+
+        class Meter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def add(self):
+                with self._lock:
+                    self._n += 1
+
+            def peek(self):
+                return self._n
+        """
+    # same bug outside telemetry/ is out of scope
+    assert run(guarded_elsewhere, rule="lock-discipline",
+               path="training/fixture.py") == []
+    # a class whose attrs are never touched under the lock has no
+    # inferred guard set: nothing to flag
+    assert run("""
+        import threading
+
+        class Plain:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def peek(self):
+                return self._n
+        """, rule="lock-discipline", path="telemetry/fixture.py") == []
+
+
 # ------------------------------------------------------------- suppression
 
 def test_trailing_suppression_comment():
@@ -463,12 +523,44 @@ def test_cli_clean_after_write_baseline(tmp_path):
     assert json.loads(r.stdout)["counts"]["new"] == 1
 
 
-def test_cli_list_rules_names_all_eight():
+def test_cli_list_rules_names_all_nine():
     r = _cli("--list-rules")
     assert r.returncode == 0
     for rule in ALL_RULES:
         assert rule.name in r.stdout
-    assert len(ALL_RULES) == 8
+    assert len(ALL_RULES) == 9
+
+
+def test_cli_unknown_rule_exits_2():
+    r = _cli("--rules", "no-such-rule")
+    assert r.returncode == 2
+    assert "no-such-rule" in r.stderr
+
+
+def test_cli_json_findings_carry_col_and_end_line(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x):\n    assert (x > 0\n            and x < 9)\n")
+    r = _cli(str(bad), "--json", "--no-baseline")
+    assert r.returncode == 1
+    f = json.loads(r.stdout)["new_findings"][0]
+    assert f["col"] == 5
+    assert f["end_line"] == 3       # the assert spans two lines
+    assert f["end_line"] >= f["line"]
+
+
+def test_cli_changed_rejects_explicit_paths(tmp_path):
+    r = _cli("--changed", str(tmp_path))
+    assert r.returncode == 2
+    assert "--changed" in r.stderr
+
+
+def test_cli_changed_gates_only_changed_files():
+    # runs against the real repo work tree: whatever its dirty state, the
+    # changed-file scope must be a subset of the full-package findings and
+    # the summary must say so
+    r = _cli("--changed")
+    assert r.returncode in (0, 1)
+    assert "[changed files only]" in r.stdout
 
 
 def test_package_is_clean_against_committed_baseline():
@@ -482,3 +574,121 @@ def test_package_is_clean_against_committed_baseline():
     baseline = load_baseline(default_baseline_path())
     new, _ = split_new(findings, baseline)
     assert new == [], "\n".join(f.human() for f in new)
+
+
+# ------------------------------------- cross-module reachability (v2)
+# Each fixture is a two-module package where the traced entrypoint and
+# the offending helper live in DIFFERENT files. The per-module
+# approximation (cross_module=False) provably misses the bug; the
+# whole-package fixpoint (the default) catches it.
+
+def _write_pkg(tmp_path, files):
+    pkg = tmp_path / "xpkg"
+    pkg.mkdir()
+    pkg.joinpath("__init__.py").write_text(
+        textwrap.dedent(files.pop("__init__.py", "")))
+    for name, src in files.items():
+        pkg.joinpath(name).write_text(textwrap.dedent(src))
+    return str(pkg)
+
+
+def _pkg_lint(pkg, rule, cross_module):
+    return lint_paths([pkg], rules=[RULES_BY_NAME[rule]], known_axes=AXES,
+                      cross_module=cross_module)
+
+
+def test_cross_module_host_sync_in_imported_helper(tmp_path):
+    pkg = _write_pkg(tmp_path, {
+        "entry.py": """
+            import jax
+            from .helper import summarize
+
+            @jax.jit
+            def step(x):
+                return summarize(x)
+            """,
+        "helper.py": """
+            def summarize(x):
+                return x.sum().item()
+            """,
+    })
+    assert _pkg_lint(pkg, "host-sync-in-hot-path", False) == []
+    hit = _pkg_lint(pkg, "host-sync-in-hot-path", True)
+    assert [f for f in hit if f.path.endswith("helper.py")]
+    assert "item" in hit[0].message
+
+
+def test_cross_module_traced_control_flow_one_import_away(tmp_path):
+    pkg = _write_pkg(tmp_path, {
+        "entry.py": """
+            import jax
+            from .branchy import pick
+
+            @jax.jit
+            def run(x):
+                return pick(x)
+            """,
+        "branchy.py": """
+            import jax.numpy as jnp
+
+            def pick(x):
+                y = jnp.sum(x)
+                if y > 0:          # TracerBoolConversionError at run time
+                    return y
+                return -y
+            """,
+    })
+    assert _pkg_lint(pkg, "traced-control-flow", False) == []
+    hit = _pkg_lint(pkg, "traced-control-flow", True)
+    assert [f for f in hit if f.path.endswith("branchy.py")]
+
+
+def test_cross_module_numpy_via_jit_wrapper_of_imported_fn(tmp_path):
+    # the trainstep _wrap idiom across a module boundary: the wrapped fn
+    # is defined elsewhere and only becomes hot via the wrapper call
+    pkg = _write_pkg(tmp_path, {
+        "entry.py": """
+            import jax
+            from .mathy import normalize
+
+            def _wrap(fn):
+                return jax.jit(fn, donate_argnums=(0,))
+
+            step = _wrap(normalize)
+            """,
+        "mathy.py": """
+            import numpy as np
+
+            def normalize(x):
+                return x / np.sum(x)
+            """,
+    })
+    assert _pkg_lint(pkg, "host-sync-in-hot-path", False) == []
+    hit = _pkg_lint(pkg, "host-sync-in-hot-path", True)
+    assert [f for f in hit if f.path.endswith("mathy.py")]
+    assert "np." in hit[0].message or "numpy" in hit[0].message
+
+
+def test_cross_module_reexport_chain_through_init(tmp_path):
+    # entry imports the helper through the package __init__ re-export;
+    # the fixpoint must follow the chain to the defining module
+    pkg = _write_pkg(tmp_path, {
+        "__init__.py": """
+            from .helper import summarize
+            """,
+        "entry.py": """
+            import jax
+            from . import summarize
+
+            @jax.jit
+            def step(x):
+                return summarize(x)
+            """,
+        "helper.py": """
+            def summarize(x):
+                return float(x[0])
+            """,
+    })
+    assert _pkg_lint(pkg, "host-sync-in-hot-path", False) == []
+    hit = _pkg_lint(pkg, "host-sync-in-hot-path", True)
+    assert [f for f in hit if f.path.endswith("helper.py")]

@@ -504,3 +504,55 @@ def test_acceptance_chaos_nan_stream_validates_and_reports(tmp_path):
     assert sparse and all(r["bytes_sent"] > 0 for r in sparse)
     text = format_report(s)
     assert "rollbacks=1" in text and "io_retries=1" in text
+
+
+def test_report_program_audit_join(tmp_path):
+    """``report --audit``: the run's (compressor, wire, overlap) key joins
+    to exactly the audited arms with the same key; a stream that recorded
+    no key fields matches nothing (an all-arms match would misread as a
+    certification)."""
+    audit = {
+        "git_rev": "abc1234", "jax_version": jax.__version__, "ok": True,
+        "arms": {
+            "pipe_wire": {"fingerprint": "f" * 16,
+                          "wire_format": "u16bf16", "overlap": "pipelined",
+                          "config": {"selector": "topk"}},
+            "seq_legacy": {"fingerprint": "0" * 16,
+                           "wire_format": "i32f32", "overlap": "off",
+                           "config": {"selector": "topk"}},
+            "dense": {"fingerprint": "d" * 16,
+                      "wire_format": "i32f32", "overlap": "off",
+                      "config": {"selector": "topk", "dense": True}},
+        },
+    }
+    events = [
+        {"event": "config", "schema_version": 1, "compressor": "topk"},
+        {"event": "train", "schema_version": 1, "step": 1,
+         "wire_format": "u16bf16", "overlap": "pipelined"},
+    ]
+    s = summarize(events, audit=audit)
+    pa = s["program_audit"]
+    assert pa["audit_git_rev"] == "abc1234"
+    assert pa["run_program_key"]["wire_format"] == "u16bf16"
+    assert [m["arm"] for m in pa["matched_arms"]] == ["pipe_wire"]
+    text = format_report(s)
+    assert "program audit join" in text and "pipe_wire" in text
+
+    # keyless stream: no match, and the report says so rather than
+    # listing every arm
+    s2 = summarize([{"event": "bench_summary", "schema_version": 1}],
+                   audit=audit)
+    assert s2["program_audit"]["matched_arms"] == []
+    assert "no audited arm matches" in format_report(s2)
+
+    # the CLI surfaces the join and exits 2 on an unreadable artifact
+    ev_path = os.path.join(str(tmp_path), "ev.jsonl")
+    with open(ev_path, "w", encoding="utf-8") as fh:
+        for e in events:
+            fh.write(json.dumps(e) + "\n")
+    audit_path = os.path.join(str(tmp_path), "audit.json")
+    with open(audit_path, "w", encoding="utf-8") as fh:
+        json.dump(audit, fh)
+    assert telemetry_cli(["report", ev_path, "--audit", audit_path]) == 0
+    assert telemetry_cli(["report", ev_path, "--audit",
+                          os.path.join(str(tmp_path), "nope.json")]) == 2
