@@ -5,22 +5,29 @@ Nine AST rules enforcing the repo's jit/donation/collective invariants
 mesh-axis-consistency, donation-check, traced-control-flow, fail-loud,
 print-in-library, collective-outside-pipeline, lock-discipline — plus
 the v2 program tier (``lint audit``, lint/program_audit.py) that checks
-the jaxpr the source actually builds.
+the jaxpr the source actually builds, and the v3 host tiers:
+``lint concurrency`` (lint/concurrency.py — per-class lock model,
+callback/blocking-under-lock, thread escapes) and ``lint events``
+(lint/event_contract.py — publish sites vs EVENT_SCHEMAS, ratcheted in
+.gklint-events.json).
 
 CLI: ``python -m gaussiank_sgd_tpu.lint [--json] [paths...]`` — exits
-nonzero on findings not in the committed baseline. Library entry points:
+nonzero on findings not in the committed baseline, 2 on a suppression
+without a ``-- justification``. Library entry points:
 
     from gaussiank_sgd_tpu.lint import lint_source, lint_paths
 """
 
 from .baseline import (default_baseline_path, load_baseline, split_new,
                        write_baseline)
-from .core import Finding, lint_paths, lint_source
+from .core import (Finding, Suppression, lint_paths, lint_paths_detailed,
+                   lint_source, lint_source_detailed)
 from .reachability import PackageReachability
 from .rules import ALL_RULES, RULES_BY_NAME, select_rules
 
 __all__ = [
     "ALL_RULES", "Finding", "PackageReachability", "RULES_BY_NAME",
-    "default_baseline_path", "lint_paths", "lint_source", "load_baseline",
-    "select_rules", "split_new", "write_baseline",
+    "Suppression", "default_baseline_path", "lint_paths",
+    "lint_paths_detailed", "lint_source", "lint_source_detailed",
+    "load_baseline", "select_rules", "split_new", "write_baseline",
 ]

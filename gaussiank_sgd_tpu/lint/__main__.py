@@ -6,13 +6,19 @@
     python -m gaussiank_sgd_tpu.lint --write-baseline # accept current set
     python -m gaussiank_sgd_tpu.lint --list-rules
     python -m gaussiank_sgd_tpu.lint path/to/file.py another/dir
-    python -m gaussiank_sgd_tpu.lint audit [...]      # jaxpr program tier
+    python -m gaussiank_sgd_tpu.lint audit [...]       # jaxpr program tier
+    python -m gaussiank_sgd_tpu.lint concurrency [...] # host lock/race tier
+    python -m gaussiank_sgd_tpu.lint events [...]      # event contract tier
 
 Exit codes: 0 clean (or all findings baselined), 1 new findings, 2 usage
-error. The AST tier is pure-AST: it runs without initializing jax/TPU.
-The ``audit`` subcommand is the v2 program tier (lint/program_audit.py);
-it traces the jitted step on the CPU backend, so it DOES import jax — its
-flags are documented in ``... lint audit --help``.
+error or a suppression without a ``-- justification``. The AST,
+``concurrency`` and ``events`` tiers are pure-AST: they run without
+initializing jax/TPU. The ``audit`` subcommand is the v2 program tier
+(lint/program_audit.py); it traces the jitted step on the CPU backend, so
+it DOES import jax — its flags are documented in ``... lint audit --help``.
+
+``--format github`` prints workflow-command annotations
+(``::error file=...``) so findings annotate PR diffs in CI.
 """
 
 from __future__ import annotations
@@ -22,16 +28,106 @@ import json
 import os
 import subprocess
 import sys
-from typing import Any, Dict, List, Optional, Set
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from .baseline import (default_baseline_path, load_baseline, split_new,
                        write_baseline)
-from .core import Finding, lint_paths
+from .core import Finding, Suppression, lint_paths_detailed
 from .rules import ALL_RULES, select_rules
 
 
 def _default_paths() -> List[str]:
     return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def _repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _print_findings(findings: Sequence[Finding], fmt: str) -> None:
+    for f in findings:
+        if fmt == "github":
+            sev = "error" if f.severity == "error" else "warning"
+            end = f.end_line or f.line
+            print(f"::{sev} file={f.path},line={max(f.line, 1)},"
+                  f"endLine={max(end, 1)},title=gklint "
+                  f"{f.rule}::{f.message}")
+        else:
+            print(f.human())
+
+
+def _add_format_flags(ap: argparse.ArgumentParser) -> None:
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="JSON output (alias for --format json)")
+    ap.add_argument("--format", choices=("text", "json", "github"),
+                    default="text",
+                    help="output format; `github` prints workflow-command "
+                         "annotations for PR diffs")
+
+
+def _resolve_format(args: argparse.Namespace) -> str:
+    return "json" if args.as_json else args.format
+
+
+# -- suppression hygiene (satellite of gklint v3) --------------------------
+
+def check_suppressions(sups: Sequence[Suppression],
+                       active_rules: Set[str],
+                       full_run: bool) -> Tuple[List[Suppression],
+                                                List[Suppression]]:
+    """(missing-justification, stale) suppression rows for this run.
+
+    A suppression is *relevant* when it names a rule the run executed (or
+    is a ``*`` wildcard on a full-rule-set run) — a ``conc-*`` suppression
+    is not stale just because the plain AST tier never runs that rule.
+    Stale analysis only applies on ``full_run`` (no ``--rules`` subset, no
+    ``--changed`` scoping), where "nothing matched" is meaningful.
+    """
+    missing = [s for s in sups if not s.justification]
+    stale: List[Suppression] = []
+    if full_run:
+        for s in sups:
+            relevant = bool(s.rules & active_rules) or "*" in s.rules
+            if relevant and not s.matched:
+                stale.append(s)
+    return missing, stale
+
+
+def _suppression_findings(stale: Sequence[Suppression]) -> List[Finding]:
+    return [Finding(
+        rule="stale-suppression", severity="warning", path=s.path,
+        line=s.line, col=1,
+        message=f"suppression of {', '.join(sorted(s.rules))} no longer "
+                f"masks any finding — remove the comment",
+        source_line=s.source_line) for s in stale]
+
+
+def _gate_suppressions(missing: Sequence[Suppression],
+                       stale: Sequence[Suppression],
+                       strict: bool, fmt: str) -> Tuple[List[Finding], bool]:
+    """Print justification errors / stale warnings. Returns
+    ``(stale_as_findings, hard_fail)`` — strict mode turns stale rows into
+    findings; a missing justification is always a hard exit-2 failure."""
+    for s in missing:
+        msg = (f"{s.path}:{s.line}: suppression of "
+               f"{', '.join(sorted(s.rules))} has no `-- justification` "
+               f"(docs/LINTING.md)")
+        if fmt == "github":
+            print(f"::error file={s.path},line={s.line},title=gklint "
+                  f"suppression::{msg}")
+        else:
+            print(f"error: {msg}")
+    stale_findings = _suppression_findings(stale)
+    if not strict:
+        for f in stale_findings:
+            if fmt == "github":
+                print(f"::warning file={f.path},line={f.line},"
+                      f"title=gklint {f.rule}::{f.message}")
+            elif fmt != "json":
+                print(f"warning: {f.path}:{f.line}: {f.message}")
+        stale_findings = []
+    return stale_findings, bool(missing)
 
 
 def _changed_py_files(repo_root: str) -> Optional[Set[str]]:
@@ -56,13 +152,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         argv = sys.argv[1:]
     if argv and argv[0] == "audit":
         return _audit_main(argv[1:])
+    if argv and argv[0] == "concurrency":
+        return _concurrency_main(argv[1:])
+    if argv and argv[0] == "events":
+        return _events_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m gaussiank_sgd_tpu.lint",
         description="JAX-aware static analysis for the TPU training stack")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the package)")
-    ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="JSON output")
+    _add_format_flags(ap)
     ap.add_argument("--rules", help="comma-separated subset of rules to run")
     ap.add_argument("--baseline", default=None,
                     help="baseline file (default: <repo>/"
@@ -75,6 +174,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="report/gate only findings in files changed vs "
                          "git HEAD (the whole package is still analysed "
                          "so cross-module reachability stays exact)")
+    ap.add_argument("--strict-suppressions", action="store_true",
+                    help="stale suppressions (masking nothing) become "
+                         "gating findings instead of warnings")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -95,12 +197,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     paths = args.paths or _default_paths()
+    fmt = _resolve_format(args)
     # findings are repo-root-relative when linting the installed package so
     # the committed baseline matches from any cwd
-    pkg_parent = os.path.dirname(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    findings = lint_paths(paths, rules=rules,
-                          rel_to=pkg_parent if not args.paths else None)
+    pkg_parent = _repo_root()
+    findings, sups = lint_paths_detailed(
+        paths, rules=rules, rel_to=pkg_parent if not args.paths else None)
 
     if args.changed:
         changed = _changed_py_files(pkg_parent)
@@ -120,7 +222,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     baseline = {} if args.no_baseline else load_baseline(baseline_path)
     new, old = split_new(findings, baseline)
 
-    if args.as_json:
+    # suppression hygiene: baselined findings still count as "masked" for
+    # staleness (the suppression matched during lint), and a subset run
+    # (--rules / --changed / explicit paths) never reports staleness
+    full_run = not (args.rules or args.changed or args.paths)
+    missing, stale = check_suppressions(
+        sups, {r.name for r in rules}, full_run)
+    stale_findings, hard_fail = _gate_suppressions(
+        missing, stale, args.strict_suppressions, fmt)
+    new = sorted(new + stale_findings,
+                 key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    if fmt == "json":
         print(json.dumps({
             "tool": "gklint",
             "checked_paths": paths,
@@ -129,10 +242,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                        "baselined": len(old)},
             "new_findings": [f.to_json() for f in new],
             "baselined_findings": [f.to_json() for f in old],
+            "suppressions": [s.to_json() for s in sups],
+            "stale_suppressions": [s.to_json() for s in stale],
+            "unjustified_suppressions": [s.to_json() for s in missing],
         }, indent=2))
     else:
-        for f in new:
-            print(f.human())
+        _print_findings(new, fmt)
         summary = (f"gklint: {len(new)} new finding(s), "
                    f"{len(old)} baselined, "
                    f"{len(ALL_RULES) if not args.rules else len(rules)} "
@@ -140,9 +255,128 @@ def main(argv: Optional[List[str]] = None) -> int:
                    + (" [changed files only]" if args.changed else ""))
         print(summary)
         if new:
-            print("  fix, suppress with `# gklint: disable=<rule>`, or "
-                  "accept via --write-baseline (docs/LINTING.md)")
+            print("  fix, suppress with `# gklint: disable=<rule> -- "
+                  "<justification>`, or accept via --write-baseline "
+                  "(docs/LINTING.md)")
+    if hard_fail:
+        return 2
     return 1 if new else 0
+
+
+def _concurrency_main(argv: List[str]) -> int:
+    from .concurrency import CONCURRENCY_RULES, lint_concurrency
+    ap = argparse.ArgumentParser(
+        prog="python -m gaussiank_sgd_tpu.lint concurrency",
+        description="host-runtime concurrency tier: per-class lock model "
+                    "(guarded-state discipline), callback-under-lock, "
+                    "thread-escape, blocking-in-critical-section — "
+                    "whole-package, pure-AST")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyse (default: the package)")
+    _add_format_flags(ap)
+    ap.add_argument("--strict-suppressions", action="store_true",
+                    help="stale suppressions become gating findings")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+    fmt = _resolve_format(args)
+
+    if args.list_rules:
+        for r in CONCURRENCY_RULES:
+            print(f"{r.name:26s} [{r.severity}] {r.description}")
+        return 0
+
+    paths = args.paths or _default_paths()
+    findings, sups = lint_concurrency(
+        paths, rel_to=_repo_root() if not args.paths else None)
+
+    conc_names = {r.name for r in CONCURRENCY_RULES}
+    missing, stale = check_suppressions(sups, conc_names,
+                                        full_run=not args.paths)
+    stale_findings, hard_fail = _gate_suppressions(
+        missing, stale, args.strict_suppressions, fmt)
+    findings = sorted(findings + stale_findings,
+                      key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    if fmt == "json":
+        print(json.dumps({
+            "tool": "gklint-concurrency",
+            "checked_paths": paths,
+            "counts": {"total": len(findings)},
+            "findings": [f.to_json() for f in findings],
+            "stale_suppressions": [s.to_json() for s in stale],
+            "unjustified_suppressions": [s.to_json() for s in missing],
+        }, indent=2))
+    else:
+        _print_findings(findings, fmt)
+        print(f"gklint concurrency: {len(findings)} finding(s), "
+              f"{len(CONCURRENCY_RULES)} rule(s)")
+        if findings:
+            print("  fix, or suppress with `# gklint: disable=<rule> -- "
+                  "<justification>` where the pattern is by design "
+                  "(docs/LINTING.md)")
+    if hard_fail:
+        return 2
+    return 1 if findings else 0
+
+
+def _events_main(argv: List[str]) -> int:
+    from .event_contract import default_events_path, run_events_check
+    ap = argparse.ArgumentParser(
+        prog="python -m gaussiank_sgd_tpu.lint events",
+        description="event-contract tier: statically resolve every "
+                    "publish/emit site to its event kind and cross-check "
+                    "payload keys against EVENT_SCHEMAS, ratcheted in "
+                    ".gklint-events.json")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: the package plus "
+                         "bench.py and analysis/)")
+    _add_format_flags(ap)
+    ap.add_argument("--events-file", default=None,
+                    help="committed snapshot (default: "
+                         "<repo>/.gklint-events.json)")
+    ap.add_argument("--write-events", action="store_true",
+                    help="re-baseline: write the current contract "
+                         "snapshot to the events file")
+    ap.add_argument("-o", "--out", default=None,
+                    help="also write the full report JSON here (the CI "
+                         "artifact)")
+    args = ap.parse_args(argv)
+    fmt = _resolve_format(args)
+
+    snap_path = args.events_file or default_events_path()
+    findings, sites, snap = run_events_check(
+        paths=args.paths or None, snap_path=snap_path,
+        write=args.write_events, rel_to=_repo_root())
+
+    report = {
+        "tool": "gklint-events",
+        "counts": {"findings": len(findings), "sites": len(sites),
+                   "kinds": len(snap.get("kinds", {}))},
+        "findings": [f.to_json() for f in findings],
+        "sites": [s.to_json() for s in sites],
+        "snapshot": snap,
+    }
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2, sort_keys=False)
+            fh.write("\n")
+
+    if args.write_events:
+        print(f"gklint events: wrote {len(snap.get('kinds', {}))} kind(s) "
+              f"({len(sites)} site(s)) to {snap_path}")
+
+    if fmt == "json":
+        print(json.dumps(report, indent=2, sort_keys=False))
+    else:
+        _print_findings(findings, fmt)
+        print(f"gklint events: {len(findings)} finding(s), "
+              f"{len(sites)} publish site(s), "
+              f"{len(snap.get('kinds', {}))} kind(s)")
+        if findings:
+            print("  align EVENT_SCHEMAS with the publish sites, or "
+                  "re-baseline intentional drift with --write-events "
+                  "(docs/LINTING.md)")
+    return 1 if findings else 0
 
 
 def _audit_human_report(report: Dict[str, Any], fp_violations: List[str],

@@ -16,6 +16,15 @@ Suppression syntax (documented in docs/LINTING.md):
   * whole file:    ``# gklint: disable-file=<rule>[,<rule>...]``
 
 ``disable=all`` (or ``*``) suppresses every rule at that site.
+
+Every suppression must carry a justification after ``--``::
+
+    with self._lock:
+        self._f.write(line)  # gklint: disable=conc-blocking-under-lock -- serialize dump+write
+
+The CLI exits 2 on justification-less suppressions, and reports
+suppressions that no longer mask any finding as stale (warnings by
+default; findings under ``--strict-suppressions``).
 """
 
 from __future__ import annotations
@@ -33,8 +42,12 @@ from .reachability import JitReachability
 
 SEVERITIES = ("error", "warning")
 
+# rules part is a strict comma list of rule tokens so a ``-- justification``
+# tail is never swallowed by the character class.
 _SUPPRESS_RE = re.compile(
-    r"#\s*gklint:\s*(disable|disable-file)\s*=\s*([\w\-,* ]+)")
+    r"#\s*gklint:\s*(disable|disable-file)\s*=\s*"
+    r"([\w*][\w\-*]*(?:\s*,\s*[\w*][\w\-*]*)*)"
+    r"(?:\s*--\s*(\S.*?)\s*$)?")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +89,72 @@ class Finding:
                 f"[{self.severity}] {self.rule}: {self.message}")
 
 
+@dataclasses.dataclass
+class Suppression:
+    """One ``# gklint: disable=...`` comment, tracked for staleness.
+
+    ``target_line`` is the 1-based line the suppression masks (0 for
+    file-wide). ``matched`` is flipped by :meth:`ModuleCtx.is_suppressed`
+    whenever the entry actually masks a finding, so the CLI can report
+    suppressions that no longer mask anything.
+    """
+
+    path: str
+    line: int
+    target_line: int
+    kind: str  # "line" | "file"
+    rules: frozenset
+    justification: str
+    source_line: str = ""
+    matched: bool = False
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "path": self.path, "line": self.line,
+            "target_line": self.target_line, "kind": self.kind,
+            "rules": sorted(self.rules),
+            "justification": self.justification,
+            "matched": self.matched,
+            "source": self.source_line.strip(),
+        }
+
+
+def parse_suppression_entries(source: str,
+                              path: str = "<string>") -> List[Suppression]:
+    """All suppression comments in ``source`` as :class:`Suppression` rows."""
+    entries: List[Suppression] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError):  # half-written file
+        return entries
+    lines = source.splitlines()
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        kind, raw, just = m.group(1), m.group(2), m.group(3) or ""
+        rules = {r.strip() for r in raw.split(",") if r.strip()}
+        if "all" in rules or "*" in rules:
+            rules = {"*"}
+        row = tok.start[0]
+        src = lines[row - 1] if row - 1 < len(lines) else ""
+        if kind == "disable-file":
+            entries.append(Suppression(
+                path=path, line=row, target_line=0, kind="file",
+                rules=frozenset(rules), justification=just,
+                source_line=src))
+            continue
+        text_before = lines[row - 1][:tok.start[1]].strip() \
+            if row - 1 < len(lines) else ""
+        target = row if text_before else row + 1
+        entries.append(Suppression(
+            path=path, line=row, target_line=target, kind="line",
+            rules=frozenset(rules), justification=just, source_line=src))
+    return entries
+
+
 def parse_suppressions(source: str):
     """(line -> rules) suppression maps from the comment stream.
 
@@ -85,29 +164,11 @@ def parse_suppressions(source: str):
     """
     per_line: Dict[int, Set[str]] = {}
     whole_file: Set[str] = set()
-    try:
-        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
-    except (tokenize.TokenError, IndentationError):  # half-written file
-        return per_line, whole_file
-    lines = source.splitlines()
-    for tok in tokens:
-        if tok.type != tokenize.COMMENT:
-            continue
-        m = _SUPPRESS_RE.search(tok.string)
-        if not m:
-            continue
-        kind, raw = m.group(1), m.group(2)
-        rules = {r.strip() for r in raw.split(",") if r.strip()}
-        if "all" in rules or "*" in rules:
-            rules = {"*"}
-        if kind == "disable-file":
-            whole_file |= rules
-            continue
-        row = tok.start[0]
-        text_before = lines[row - 1][:tok.start[1]].strip() \
-            if row - 1 < len(lines) else ""
-        target = row if text_before else row + 1
-        per_line.setdefault(target, set()).update(rules)
+    for s in parse_suppression_entries(source):
+        if s.kind == "file":
+            whole_file |= s.rules
+        else:
+            per_line.setdefault(s.target_line, set()).update(s.rules)
     return per_line, whole_file
 
 
@@ -123,6 +184,7 @@ class ModuleCtx:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         self.known_axes = known_axes or set()
+        self.suppressions = parse_suppression_entries(source, path=path)
         self.suppressed_lines, self.suppressed_file = \
             parse_suppressions(source)
         for parent in ast.walk(self.tree):
@@ -154,10 +216,14 @@ class ModuleCtx:
                        end_line=getattr(node, "end_lineno", 0) or 0)
 
     def is_suppressed(self, f: Finding) -> bool:
-        if {f.rule, "*"} & self.suppressed_file:
-            return True
-        rules = self.suppressed_lines.get(f.line, set())
-        return bool({f.rule, "*"} & rules)
+        hit = False
+        for s in self.suppressions:
+            if not ({f.rule, "*"} & s.rules):
+                continue
+            if s.kind == "file" or s.target_line == f.line:
+                s.matched = True
+                hit = True
+        return hit
 
 
 def iter_py_files(paths: Sequence[str],
@@ -176,6 +242,24 @@ def iter_py_files(paths: Sequence[str],
     return out
 
 
+def lint_source_detailed(source: str, path: str = "<string>", rules=None,
+                         known_axes: Optional[Set[str]] = None,
+                         extra_roots: Iterable[str] = ()):
+    """Lint one source string; return ``(findings, suppressions)``.
+
+    The suppression rows have ``matched`` set when they masked a finding
+    of this run — the raw material of the stale-suppression detector.
+    """
+    from .rules import ALL_RULES
+    ctx = ModuleCtx(path, source, known_axes=known_axes,
+                    extra_roots=extra_roots)
+    found: List[Finding] = []
+    for rule in (rules if rules is not None else ALL_RULES):
+        found.extend(f for f in rule.check(ctx) if not ctx.is_suppressed(f))
+    found.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return found, ctx.suppressions
+
+
 def lint_source(source: str, path: str = "<string>", rules=None,
                 known_axes: Optional[Set[str]] = None,
                 extra_roots: Iterable[str] = ()) -> List[Finding]:
@@ -185,14 +269,54 @@ def lint_source(source: str, path: str = "<string>", rules=None,
     this module that a traced caller elsewhere references); ``lint_paths``
     computes it from :class:`~.reachability.PackageReachability`.
     """
-    from .rules import ALL_RULES
-    ctx = ModuleCtx(path, source, known_axes=known_axes,
-                    extra_roots=extra_roots)
+    return lint_source_detailed(source, path=path, rules=rules,
+                                known_axes=known_axes,
+                                extra_roots=extra_roots)[0]
+
+
+def lint_paths_detailed(paths: Sequence[str], rules=None,
+                        known_axes: Optional[Set[str]] = None,
+                        rel_to: Optional[str] = None,
+                        cross_module: bool = True):
+    """:func:`lint_paths`, plus every suppression row seen along the way.
+
+    Returns ``(findings, suppressions)``; suppression paths are made
+    relative to ``rel_to`` like finding paths.
+    """
+    from .reachability import PackageReachability
+    from .rules import discover_known_axes
+    files = iter_py_files(paths)
+    if known_axes is None:
+        known_axes = discover_known_axes(files)
+    base = os.path.abspath(rel_to or os.getcwd())
+    sources: List[tuple] = []
+    for fpath in files:
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                sources.append((fpath, fh.read()))
+        except (OSError, UnicodeDecodeError):
+            continue
+    pkg_reach = PackageReachability(sources) if cross_module else None
     found: List[Finding] = []
-    for rule in (rules if rules is not None else ALL_RULES):
-        found.extend(f for f in rule.check(ctx) if not ctx.is_suppressed(f))
+    sups: List[Suppression] = []
+    for fpath, source in sources:
+        rel = os.path.relpath(os.path.abspath(fpath), base)
+        extra = (pkg_reach.extra_roots_for(fpath) if pkg_reach is not None
+                 else frozenset())
+        try:
+            f, s = lint_source_detailed(source, path=rel, rules=rules,
+                                        known_axes=known_axes,
+                                        extra_roots=extra)
+            found.extend(f)
+            sups.extend(s)
+        except SyntaxError as e:
+            found.append(Finding(
+                rule="parse-error", severity="error", path=rel,
+                line=e.lineno or 0, col=(e.offset or 0),
+                message=f"file does not parse: {e.msg}"))
     found.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return found
+    sups.sort(key=lambda s: (s.path, s.line))
+    return found, sups
 
 
 def lint_paths(paths: Sequence[str], rules=None,
@@ -207,33 +331,5 @@ def lint_paths(paths: Sequence[str], rules=None,
     only traced via imports from another module. Still pure-AST: nothing
     is imported or executed.
     """
-    from .reachability import PackageReachability
-    from .rules import ALL_RULES, discover_known_axes
-    files = iter_py_files(paths)
-    if known_axes is None:
-        known_axes = discover_known_axes(files)
-    base = os.path.abspath(rel_to or os.getcwd())
-    sources: List[tuple] = []
-    for fpath in files:
-        try:
-            with open(fpath, "r", encoding="utf-8") as fh:
-                sources.append((fpath, fh.read()))
-        except (OSError, UnicodeDecodeError):
-            continue
-    pkg_reach = PackageReachability(sources) if cross_module else None
-    found: List[Finding] = []
-    for fpath, source in sources:
-        rel = os.path.relpath(os.path.abspath(fpath), base)
-        extra = (pkg_reach.extra_roots_for(fpath) if pkg_reach is not None
-                 else frozenset())
-        try:
-            found.extend(lint_source(source, path=rel, rules=rules,
-                                     known_axes=known_axes,
-                                     extra_roots=extra))
-        except SyntaxError as e:
-            found.append(Finding(
-                rule="parse-error", severity="error", path=rel,
-                line=e.lineno or 0, col=(e.offset or 0),
-                message=f"file does not parse: {e.msg}"))
-    found.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return found
+    return lint_paths_detailed(paths, rules=rules, known_axes=known_axes,
+                               rel_to=rel_to, cross_module=cross_module)[0]

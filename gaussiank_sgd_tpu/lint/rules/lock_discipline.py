@@ -19,8 +19,12 @@ Exemptions (the repo's established conventions):
     called-while-holding-the-lock convention (e.g.
     ``PrometheusTextfileExporter._write_locked``).
 
-Scoped to ``telemetry/``: lock usage elsewhere (if any appears) has its
-own idioms and this heuristic would be noise there.
+Scoped to the packages that actually run host threads: ``telemetry/``
+(bus/exporters/health/tracing), ``policy/`` (engine state read by the
+health monitor), ``training/`` (metrics writer driven from the trainer
+and prefetch threads), and ``data/loader.py`` (the prefetch thread
+itself). Lock usage elsewhere (if any appears) has its own idioms and
+this heuristic would be noise there.
 """
 
 from __future__ import annotations
@@ -36,6 +40,18 @@ SEVERITY = "warning"
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 _EXEMPT_METHODS = {"__init__", "__new__"}
+
+# directories whose modules run host threads and follow the
+# self._lock / *_locked convention; plus individually listed files
+_THREADED_DIRS = {"telemetry", "policy", "training"}
+_THREADED_FILES = {"loader.py"}
+
+
+def _in_scope(path: str) -> bool:
+    if os.path.basename(os.path.dirname(path)) in _THREADED_DIRS:
+        return True
+    return (os.path.basename(path) in _THREADED_FILES
+            and os.path.basename(os.path.dirname(path)) == "data")
 
 
 def _terminal_name(func: ast.AST) -> str:
@@ -74,12 +90,13 @@ def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
 class Rule:
     name = NAME
     severity = SEVERITY
-    description = ("in telemetry/, lock-guarded self._x attributes must "
+    description = ("in threaded packages (telemetry/, policy/, training/, "
+                   "data/loader.py), lock-guarded self._x attributes must "
                    "not be touched outside `with self._lock` (except in "
                    "__init__ and *_locked helpers)")
 
     def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
-        if os.path.basename(os.path.dirname(ctx.path)) != "telemetry":
+        if not _in_scope(ctx.path):
             return
         for cls in ast.walk(ctx.tree):
             if isinstance(cls, ast.ClassDef):

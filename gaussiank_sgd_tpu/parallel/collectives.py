@@ -42,9 +42,9 @@ def sparse_allgather_sum(comp: CompressedGrad, numel: int, axis_name: str,
     p = lax.psum(1, axis_name)
     # deliberately sequential reference implementation (oracle for the
     # pipelined step's parity tests; not on the trainstep hot path)
-    # gklint: disable=collective-outside-pipeline
+    # gklint: disable=collective-outside-pipeline -- sequential oracle for parity tests, off the hot path
     g_idx = lax.all_gather(comp.indices, axis_name, tiled=True)   # [P*k]
-    # gklint: disable=collective-outside-pipeline
+    # gklint: disable=collective-outside-pipeline -- sequential oracle for parity tests, off the hot path
     g_val = lax.all_gather(comp.values, axis_name, tiled=True)    # [P*k]
     dense = jnp.zeros((numel,), dtype).at[g_idx].add(g_val.astype(dtype))
     return dense / p if mean else dense

@@ -61,7 +61,7 @@ class FlatSGDM(NamedTuple):
         m = m * self.momentum if self.momentum else jnp.zeros_like(m)
         if self.weight_decay:
             # internal invariant: both callers gate on _flat_params_if_wd
-            assert flat_params is not None  # gklint: disable=fail-loud
+            assert flat_params is not None  # gklint: disable=fail-loud -- narrowing assert; callers gate on _flat_params_if_wd
             m = m + self.weight_decay * flat_params.astype(m.dtype)
         return m
 

@@ -146,10 +146,12 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
                   # the fixed selector's measured per-step exchange
                   # payload and the format it was packed in
                   "wire_format": STRING, "bytes_sent": NUMBER,
-                  # bucket-pipelined schedule (ISSUE 7): the schedule
-                  # the sparse column ran under and the exchange time
-                  # it left exposed (sparse minus exchange-ablated twin)
-                  "overlap": STRING, "exposed_exchange_ms": NUMBER},
+                  # bucket-pipelined schedule (ISSUE 7): which schedule
+                  # the sparse column ran under. (The per-config exposed
+                  # exchange time lives on ``bench_overlap`` records —
+                  # the main arm never measured it, so the field was
+                  # dropped here; lint events flags such dead fields.)
+                  "overlap": STRING},
     ),
     # bench.py overlap arm (ISSUE 7): one record per config that ran the
     # off-vs-auto schedule comparison on a pipeline-eligible uniform plan.
@@ -177,7 +179,11 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
     "policy_decision": EventSchema(
         required={"step": NUMBER, "rule": STRING, "knob": STRING,
                   "old": STRING, "new": STRING, "reason": STRING},
-        optional={"recompiles": NUMBER, "budget_left": NUMBER},
+        # decisions and reverts share one emitter (PolicyEngine._log),
+        # which may stamp ``quarantined`` on either kind — the contract
+        # checker (lint events) verifies this symmetry statically
+        optional={"recompiles": NUMBER, "budget_left": NUMBER,
+                  "quarantined": NUMBER},   # bool passes NUMBER
     ),
     "policy_revert": EventSchema(
         required={"step": NUMBER, "rule": STRING, "knob": STRING,

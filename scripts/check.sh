@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
-# The repo's check gate (docs/LINTING.md): gklint -> typecheck -> program
-# audit -> tier-1 tests, in cheap-to-expensive order so CI fails fast on
-# style/static errors before burning 12 minutes of pytest.
+# The repo's check gate (docs/LINTING.md): gklint -> concurrency ->
+# events -> typecheck -> program audit -> tier-1 tests, in
+# cheap-to-expensive order so CI fails fast on style/static errors
+# before burning ~17 minutes of pytest.
 #
 #   scripts/check.sh             # everything
 #   scripts/check.sh --no-tests  # lint (changed-files gate) + typecheck
@@ -22,10 +23,18 @@ echo "== gklint (JAX-aware static analysis) =="
 # changed vs HEAD (the whole package is still analysed, so cross-module
 # reachability stays exact); full mode gates everything.
 if [[ "${RUN_TESTS}" == "1" ]]; then
-  python -m gaussiank_sgd_tpu.lint
+  python -m gaussiank_sgd_tpu.lint --strict-suppressions
 else
   python -m gaussiank_sgd_tpu.lint --changed
 fi
+
+echo "== gklint concurrency (host lock/race tier) =="
+# pure-AST like the rule tier; no baseline — the runtime gates at zero
+python -m gaussiank_sgd_tpu.lint concurrency --strict-suppressions
+
+echo "== gklint events (event-contract tier) =="
+# publish sites vs EVENT_SCHEMAS, ratcheted in .gklint-events.json
+python -m gaussiank_sgd_tpu.lint events
 
 echo "== typecheck (mypy) =="
 if command -v mypy >/dev/null 2>&1; then
@@ -50,9 +59,9 @@ if [[ "${RUN_TESTS}" == "1" ]]; then
   fi
 
   echo "== tier-1 tests =="
-  # ROADMAP.md tier-1 verify command (870s budget, 8-device virtual CPU)
+  # ROADMAP.md tier-1 verify command (1200s budget, 8-device virtual CPU)
   rm -f /tmp/_t1.log
-  timeout -k 10 870 env JAX_PLATFORMS=cpu \
+  timeout -k 10 1200 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
     -p no:randomly 2>&1 | tee /tmp/_t1.log

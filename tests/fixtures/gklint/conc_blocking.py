@@ -1,0 +1,23 @@
+"""Fixture: conc-blocking-under-lock (positive).
+
+Four blocking calls inside one critical section: ``time.sleep``,
+``open()``, file ``.write()`` and a thread ``.join()`` — every other
+thread contending for ``self._lock`` stalls behind them.
+"""
+
+import threading
+import time
+
+
+class Writer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._worker = None
+
+    def drain(self, path):
+        with self._lock:
+            time.sleep(0.01)
+            with open(path, "a") as fh:
+                fh.write("x")
+            if self._worker is not None:
+                self._worker.join(1.0)

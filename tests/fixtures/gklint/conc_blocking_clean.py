@@ -1,0 +1,33 @@
+"""Fixture: conc-blocking-under-lock (clean twin).
+
+``Condition.wait()`` on the held lock is exempt (it releases the lock
+while waiting), and the actual I/O happens after the snapshot is taken
+outside the critical section.
+"""
+
+import threading
+
+
+class Writer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._rows = []
+
+    def put(self, row):
+        with self._lock:
+            self._rows.append(row)
+            self._cond.notify()
+
+    def wait_nonempty(self):
+        with self._cond:
+            while not self._rows:
+                self._cond.wait()  # releases the lock it waits on: exempt
+            return list(self._rows)
+
+    def drain(self, path):
+        with self._lock:
+            rows = list(self._rows)
+            self._rows.clear()
+        with open(path, "a") as fh:
+            fh.write("".join(rows))

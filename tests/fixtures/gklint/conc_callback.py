@@ -1,0 +1,27 @@
+"""Fixture: conc-callback-under-lock (positive).
+
+Three shapes of foreign code invoked inside a critical section: exporter
+fan-out over a ``self._subs`` collection, a stored ``self._hook``
+callback, and a callable parameter — each can re-enter the bus (deadlock)
+or stall every other thread contending for the lock.
+"""
+
+import threading
+
+
+class Bus:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs = []
+        self._hook = None
+
+    def publish(self, rec):
+        with self._lock:
+            for sub in self._subs:
+                sub.emit(rec)  # fan-out under the lock
+            if self._hook is not None:
+                self._hook(rec)  # stored callback under the lock
+
+    def run(self, fn):
+        with self._lock:
+            fn()  # callable parameter under the lock

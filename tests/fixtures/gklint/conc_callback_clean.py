@@ -1,0 +1,29 @@
+"""Fixture: conc-callback-under-lock (clean twin).
+
+The sanctioned shape: snapshot the collection / callback under the lock,
+release, then call — exactly the EventBus.publish discipline.
+"""
+
+import threading
+
+
+class Bus:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs = []
+        self._hook = None
+
+    def publish(self, rec):
+        with self._lock:
+            subs = tuple(self._subs)
+            hook = self._hook
+        for sub in subs:
+            sub.emit(rec)
+        if hook is not None:
+            hook(rec)
+
+    def run(self, fn):
+        with self._lock:
+            armed = self._hook is not None
+        if armed:
+            fn()

@@ -1,0 +1,28 @@
+"""Fixture: conc-thread-escape (positive).
+
+The prefetch-thread bug: the ``threading.Thread`` target writes
+``self._latest`` with no lock, and the main thread reads the same
+attribute through ``latest()`` — a torn-read/lost-update escape hatch.
+"""
+
+import threading
+
+
+class Prefetcher:
+    def __init__(self):
+        self._latest = None
+
+    def start(self):
+        def worker():
+            while True:
+                self._latest = load()  # unguarded cross-thread write
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        return t
+
+    def latest(self):
+        return self._latest
+
+
+def load():
+    return object()

@@ -1,0 +1,28 @@
+"""Fixture: conc-thread-escape (clean twin).
+
+Queue-only communication: the worker hands batches over a
+``queue.Queue`` and stores nothing shared, so there is no escape.
+"""
+
+import queue
+import threading
+
+
+class Prefetcher:
+    def __init__(self):
+        self._q = queue.Queue(maxsize=2)
+
+    def start(self):
+        def worker():
+            while True:
+                self._q.put(load())
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        return t
+
+    def latest(self):
+        return self._q.get()
+
+
+def load():
+    return object()

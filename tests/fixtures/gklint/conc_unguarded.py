@@ -1,0 +1,24 @@
+"""Fixture: conc-unguarded-access (positive).
+
+``self._n`` is touched under ``with self._lock`` in ``add``, so the lock
+model marks it guarded; ``peek`` reads it with no lock and is not a
+``*_locked`` helper — the data race the rule exists for.
+"""
+
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def add(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        return self._n  # race: guarded elsewhere, no lock here
+
+    def _bump_locked(self):
+        self._n += 2  # *_locked convention: caller holds the lock

@@ -1,0 +1,24 @@
+"""Fixture: conc-unguarded-access (clean twin).
+
+Same class as conc_unguarded.py with the race fixed the two sanctioned
+ways: take the lock, or follow the ``*_locked`` naming convention.
+"""
+
+import threading
+
+
+class Meter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def add(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        with self._lock:
+            return self._n
+
+    def _bump_locked(self):
+        self._n += 2

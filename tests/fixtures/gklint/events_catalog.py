@@ -1,0 +1,25 @@
+"""Fixture event catalog for the contract tier (`lint events`) tests.
+
+Mirrors the shape of telemetry/events.py: an ``EVENT_SCHEMAS`` dict of
+``EventSchema(required=..., optional=...)`` calls. ``tick.ghost_field``
+is set by no closed publish site in events_sites_bad.py (dead field) and
+``phantom`` has no publish site at all (dead schema entry).
+"""
+
+NUMBER = "number"
+STRING = "string"
+
+
+class EventSchema:
+    def __init__(self, required=None, optional=None):
+        self.required = required or {}
+        self.optional = optional or {}
+
+
+EVENT_SCHEMAS = {
+    "tick": EventSchema(
+        required={"step": NUMBER},
+        optional={"loss": NUMBER, "ghost_field": NUMBER},
+    ),
+    "phantom": EventSchema(required={"reason": STRING}),
+}

@@ -1,0 +1,13 @@
+"""Fixture publish sites that break the events_catalog.py contract.
+
+Against that catalog this file yields exactly one finding of each kind:
+uncataloged kind ("rogue"), a closed "tick" site missing required
+"step", a literal-key typo ("losss"), plus — at the catalog — the
+never-published "phantom" entry and the dead "tick.ghost_field".
+"""
+
+
+def run(bus):
+    bus.emit("rogue", step=3)
+    bus.emit("tick", loss=0.25)
+    bus.emit("tick", step=1, losss=0.5)

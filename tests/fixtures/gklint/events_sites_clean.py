@@ -1,0 +1,8 @@
+"""Fixture publish sites that satisfy the events_catalog.py contract:
+every cataloged kind is published, every field is set somewhere, and no
+site uses an unknown kind or literal field."""
+
+
+def run(bus):
+    bus.emit("tick", step=1, loss=0.5, ghost_field=2.0)
+    bus.emit("phantom", reason="shutdown")

@@ -394,9 +394,14 @@ def test_lock_discipline_scoped_and_quiet_on_unguarded_state():
             def peek(self):
                 return self._n
         """
-    # same bug outside telemetry/ is out of scope
+    # same bug outside the threaded packages is out of scope
     assert run(guarded_elsewhere, rule="lock-discipline",
-               path="training/fixture.py") == []
+               path="models/fixture.py") == []
+    # v3 widened the scope to every package that runs host threads
+    for scoped in ("training/fixture.py", "policy/fixture.py",
+                   "data/loader.py"):
+        assert run(guarded_elsewhere, rule="lock-discipline",
+                   path=scoped) != [], scoped
     # a class whose attrs are never touched under the lock has no
     # inferred guard set: nothing to flag
     assert run("""

@@ -1,0 +1,205 @@
+"""gklint v3 concurrency tier: every rule caught on a committed
+regression fixture (tests/fixtures/gklint/) with its clean twin quiet,
+the real package gated at zero findings, the suppression-hygiene
+machinery (justification parse, exit-2 gate, stale detection), and the
+CLI contract. Pure-AST — nothing here initializes jax.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import gaussiank_sgd_tpu
+from gaussiank_sgd_tpu.lint.__main__ import check_suppressions
+from gaussiank_sgd_tpu.lint.concurrency import (
+    CONCURRENCY_RULES, lint_concurrency)
+from gaussiank_sgd_tpu.lint.core import parse_suppression_entries
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "gklint")
+
+
+def fx(name):
+    return os.path.join(FIXTURES, name)
+
+
+def conc(path):
+    findings, _ = lint_concurrency([path])
+    return findings
+
+
+# ------------------------------------------------------ fixture coverage
+
+def test_unguarded_access_fixture_and_clean_twin():
+    found = conc(fx("conc_unguarded.py"))
+    assert [f.rule for f in found] == ["conc-unguarded-access"]
+    assert found[0].severity == "error"
+    assert "self._n" in found[0].message   # names the attr and the fix
+    assert "_locked" in found[0].message
+    assert conc(fx("conc_unguarded_clean.py")) == []
+
+
+def test_callback_under_lock_fixture_catches_all_three_shapes():
+    found = conc(fx("conc_callback.py"))
+    assert [f.rule for f in found] == ["conc-callback-under-lock"] * 3
+    msgs = " | ".join(f.message for f in found)
+    assert "self._subs" in msgs       # for sub in self._subs: sub.emit()
+    assert "stored callback" in msgs  # self._hook(rec)
+    assert "parameter" in msgs        # fn()
+    assert conc(fx("conc_callback_clean.py")) == []
+
+
+def test_thread_escape_fixture_and_queue_twin():
+    found = conc(fx("conc_thread_escape.py"))
+    assert [f.rule for f in found] == ["conc-thread-escape"]
+    assert "self._latest" in found[0].message
+    # queue-only communication is the sanctioned alternative
+    assert conc(fx("conc_thread_escape_clean.py")) == []
+
+
+def test_blocking_under_lock_fixture_and_condwait_twin():
+    found = conc(fx("conc_blocking.py"))
+    assert [f.rule for f in found] == ["conc-blocking-under-lock"] * 4
+    msgs = " | ".join(f.message for f in found)
+    assert "sleep" in msgs and "open" in msgs and "join" in msgs
+    # cond.wait() releases the held lock; I/O after the snapshot is fine
+    assert conc(fx("conc_blocking_clean.py")) == []
+
+
+def test_whole_fixture_dir_is_deterministic():
+    # lint_paths ordering contract: (path, line) sorted, clean twins add 0
+    found = conc(FIXTURES)
+    rules = [f.rule for f in found]
+    assert rules.count("conc-unguarded-access") == 1
+    assert rules.count("conc-callback-under-lock") == 3
+    assert rules.count("conc-thread-escape") == 1
+    assert rules.count("conc-blocking-under-lock") == 4
+
+
+# ------------------------------------------------- the shipped zero gate
+
+def test_real_package_has_zero_concurrency_findings():
+    """The tentpole acceptance gate: the runtime (bus turnstile, exporters,
+    health monitor, prefetch loader, policy engine) carries no concurrency
+    findings — real fixes plus three justified by-design suppressions in
+    exporters.py, not a blanket disable."""
+    pkg = os.path.dirname(gaussiank_sgd_tpu.__file__)
+    findings, sups = lint_concurrency([pkg], rel_to=os.path.dirname(pkg))
+    assert findings == [], "\n".join(f.human() for f in findings)
+    conc_sups = [s for s in sups
+                 if any(r.startswith("conc-") for r in s.rules)]
+    assert conc_sups, "expected the documented by-design suppressions"
+    assert all(s.justification for s in conc_sups)
+    assert all(s.matched for s in conc_sups), \
+        "a conc-* suppression no longer masks anything — remove it"
+
+
+# ------------------------------------------------- suppression machinery
+
+def test_justification_is_parsed_from_suppression_comment():
+    sups = parse_suppression_entries(textwrap.dedent("""\
+        x = 1  # gklint: disable=conc-blocking-under-lock -- tiny file, rate-limited
+        y = 2  # gklint: disable=fail-loud
+        """), path="mod.py")
+    assert len(sups) == 2
+    assert sups[0].justification == "tiny file, rate-limited"
+    assert sups[0].rules == frozenset({"conc-blocking-under-lock"})
+    assert not sups[1].justification
+
+
+def test_check_suppressions_staleness_is_scoped_to_active_rules():
+    sups = parse_suppression_entries(
+        "x = 1  # gklint: disable=conc-thread-escape -- handoff by design\n",
+        path="mod.py")
+    conc_names = {r.name for r in CONCURRENCY_RULES}
+    # relevant tier, full run, nothing matched -> stale
+    missing, stale = check_suppressions(sups, conc_names, full_run=True)
+    assert missing == [] and stale == sups
+    # the plain AST tier never runs conc-* rules: not stale there
+    _, stale2 = check_suppressions(sups, {"fail-loud"}, full_run=True)
+    assert stale2 == []
+    # subset/changed runs never report staleness
+    _, stale3 = check_suppressions(sups, conc_names, full_run=False)
+    assert stale3 == []
+
+
+def test_unjustified_suppression_always_hard_fails():
+    sups = parse_suppression_entries(
+        "x = 1  # gklint: disable=fail-loud\n", path="mod.py")
+    missing, _ = check_suppressions(sups, {"conc-thread-escape"},
+                                    full_run=False)
+    assert missing == sups  # checked regardless of tier or run scope
+
+
+# ----------------------------------------------------------------- CLI
+
+def _cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "gaussiank_sgd_tpu.lint", *argv],
+        capture_output=True, text=True)
+
+
+@pytest.fixture(scope="module")
+def package_cli_run():
+    """ONE full-package `lint concurrency --strict-suppressions --json`
+    shared by every CLI-on-the-real-package assertion — the whole-package
+    fixpoint costs seconds, so the suite pays it once, not per test."""
+    return _cli("concurrency", "--strict-suppressions", "--json")
+
+
+def test_cli_concurrency_lists_the_four_rules():
+    r = _cli("concurrency", "--list-rules")
+    assert r.returncode == 0
+    for rule in CONCURRENCY_RULES:
+        assert rule.name in r.stdout
+    assert len(CONCURRENCY_RULES) == 4
+
+
+def test_cli_concurrency_json_gates_fixture_findings():
+    r = _cli("concurrency", fx("conc_callback.py"), "--json")
+    assert r.returncode == 1
+    out = json.loads(r.stdout)
+    assert out["tool"] == "gklint-concurrency"
+    assert out["counts"]["total"] == 3
+    assert {f["rule"] for f in out["findings"]} \
+        == {"conc-callback-under-lock"}
+
+
+def test_cli_concurrency_package_default_is_clean(package_cli_run):
+    r = package_cli_run
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = json.loads(r.stdout)
+    assert out["tool"] == "gklint-concurrency"
+    assert out["counts"]["total"] == 0
+
+
+def test_cli_github_format_emits_workflow_commands():
+    r = _cli("concurrency", fx("conc_unguarded.py"), "--format", "github")
+    assert r.returncode == 1
+    assert "::error file=" in r.stdout
+    assert "title=gklint conc-unguarded-access" in r.stdout
+
+
+def test_cli_exit_2_on_unjustified_suppression(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x):\n"
+                   "    assert x  # gklint: disable=fail-loud\n")
+    r = _cli(str(bad), "--no-baseline")
+    assert r.returncode == 2
+    assert "justification" in r.stdout
+    # with a justification the same suppression is accepted
+    bad.write_text("def f(x):\n"
+                   "    assert x  # gklint: disable=fail-loud -- narrowing\n")
+    assert _cli(str(bad), "--no-baseline").returncode == 0
+
+
+def test_cli_strict_suppressions_full_run_reports_no_stale(package_cli_run):
+    # stale suppressions gate under --strict on a full run; the shared
+    # strict full-package run exiting 0 with empty arrays proves every
+    # committed suppression is both justified and still masking something
+    out = json.loads(package_cli_run.stdout)
+    assert out["stale_suppressions"] == []
+    assert out["unjustified_suppressions"] == []

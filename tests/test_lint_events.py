@@ -1,0 +1,221 @@
+"""gklint v3 event-contract tier: catalog parsing, publish-site
+resolution (literal emit, param backprop, payload dicts, ** spreads,
+open/closed semantics), the five contract checks on committed fixtures,
+the .gklint-events.json ratchet round-trip, and the repo's own contract
+gated at zero findings. Pure-AST — nothing here initializes jax.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import gaussiank_sgd_tpu
+from gaussiank_sgd_tpu.lint.event_contract import (
+    default_events_path, load_catalog, load_snapshot, run_events_check,
+    scan_sites, snapshot, write_snapshot)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "gklint")
+CATALOG = os.path.join(FIXTURES, "events_catalog.py")
+BAD_SITES = os.path.join(FIXTURES, "events_sites_bad.py")
+CLEAN_SITES = os.path.join(FIXTURES, "events_sites_clean.py")
+
+
+def events(sites_path, snap_path, write=True):
+    findings, sites, snap = run_events_check(
+        paths=[sites_path], events_py=CATALOG,
+        snap_path=str(snap_path), write=write)
+    return findings, sites, snap
+
+
+# --------------------------------------------------------------- catalog
+
+def test_load_catalog_parses_fixture_schemas():
+    cat, err = load_catalog(CATALOG)
+    assert err == ""
+    assert sorted(cat) == ["phantom", "tick"]
+    assert cat["tick"].required == {"step": "NUMBER"}
+    assert sorted(cat["tick"].optional) == ["ghost_field", "loss"]
+    assert cat["tick"].fields == {"step", "loss", "ghost_field"}
+
+
+def test_load_catalog_errors_are_data_not_exceptions(tmp_path):
+    cat, err = load_catalog(str(tmp_path / "nope.py"))
+    assert cat == {} and "cannot parse" in err
+    empty = tmp_path / "empty.py"
+    empty.write_text("x = 1\n")
+    cat, err = load_catalog(str(empty))
+    assert cat == {} and "no EVENT_SCHEMAS" in err
+
+
+# -------------------------------------------------------- site resolution
+
+def test_scan_resolves_emit_sites_closed():
+    sites = scan_sites([CLEAN_SITES])
+    assert [(s.kind, s.open) for s in sites] \
+        == [("tick", False), ("phantom", False)]
+    assert sites[0].keys == {"step", "loss", "ghost_field"}
+
+
+def test_scan_kwargs_spread_makes_site_open(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent("""\
+        def run(bus, extra):
+            bus.emit("tick", step=1, **extra)
+        """))
+    (site,) = scan_sites([str(p)])
+    assert site.kind == "tick" and site.open and site.keys == {"step"}
+
+
+def test_scan_backprops_kind_through_publish_param(tmp_path):
+    # the PolicyEngine._log -> self._publish(event, payload) pattern
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent("""\
+        class Engine:
+            def _log(self, kind, step):
+                payload = {"step": step}
+                payload["arm"] = "dense"
+                self._publish(kind, payload)
+
+            def decide(self):
+                self._log("decision", 1)
+
+            def revert(self):
+                self._log("revert", 2)
+        """))
+    sites = scan_sites([str(p)])
+    kinds = sorted(s.kind for s in sites)
+    assert kinds == ["decision", "revert"]
+    assert all(s.keys == {"step", "arm"} and not s.open for s in sites)
+
+
+def test_scan_payload_dict_with_spread_and_augmentation(tmp_path):
+    # the trainer eval shape: build a dict, augment it, publish **spread
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent("""\
+        def evaluate(bus):
+            out = {"loss": 0.1}
+            out["top1"] = 0.9
+            rec = {"event": "eval", "step": 3, **out}
+            bus.publish(rec)
+        """))
+    (site,) = scan_sites([str(p)])
+    assert site.kind == "eval" and not site.open
+    assert site.keys == {"step", "loss", "top1"}
+
+
+def test_scan_single_arg_emit_dict_is_ingest_not_site(tmp_path):
+    # exporter-style consumption of an existing record must not register
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent("""\
+        def forward(exporter):
+            exporter.emit({"event": "tick", "step": 1})
+        """))
+    assert scan_sites([str(p)]) == []
+
+
+# -------------------------------------------------------- contract checks
+
+def test_bad_fixture_yields_one_finding_of_each_kind(tmp_path):
+    findings, _, _ = events(BAD_SITES, tmp_path / "ev.json")
+    assert sorted(f.rule for f in findings) == [
+        "event-dead-field", "event-missing-required",
+        "event-never-published", "event-uncataloged-kind",
+        "event-unknown-field"]
+    by_rule = {f.rule: f for f in findings}
+    assert '"rogue"' in by_rule["event-uncataloged-kind"].message
+    assert '"step"' in by_rule["event-missing-required"].message
+    assert '"losss"' in by_rule["event-unknown-field"].message
+    assert '"ghost_field"' in by_rule["event-dead-field"].message
+    assert '"phantom"' in by_rule["event-never-published"].message
+    # schema-side findings anchor at the catalog, site-side at the site
+    assert by_rule["event-dead-field"].path.endswith("events_catalog.py")
+    assert by_rule["event-uncataloged-kind"].path.endswith(
+        "events_sites_bad.py")
+
+
+def test_clean_fixture_is_quiet(tmp_path):
+    findings, sites, _ = events(CLEAN_SITES, tmp_path / "ev.json")
+    assert findings == [] and len(sites) == 2
+
+
+# ----------------------------------------------------------- the ratchet
+
+def test_ratchet_roundtrip_drift_and_rebaseline(tmp_path):
+    snap_path = tmp_path / "ev.json"
+    # write=True establishes the baseline; the next plain run is clean
+    events(CLEAN_SITES, snap_path, write=True)
+    findings, _, _ = events(CLEAN_SITES, snap_path, write=False)
+    assert findings == []
+    # publishing through a different site set drifts the contract
+    findings, _, _ = events(BAD_SITES, snap_path, write=False)
+    drift = [f for f in findings if f.rule == "event-drift"]
+    assert drift and all("--write-events" in f.message for f in drift)
+    assert any('"rogue"' in f.message for f in drift)
+    # re-baselining accepts the new contract (contract findings remain)
+    findings, _, _ = events(BAD_SITES, snap_path, write=True)
+    assert [f for f in findings if f.rule == "event-drift"] == []
+
+
+def test_missing_snapshot_is_itself_a_finding(tmp_path):
+    findings, _, _ = events(CLEAN_SITES, tmp_path / "absent.json",
+                            write=False)
+    assert [f.rule for f in findings] == ["event-drift"]
+    assert "no committed events snapshot" in findings[0].message
+
+
+def test_snapshot_version_mismatch_raises(tmp_path):
+    p = tmp_path / "ev.json"
+    p.write_text('{"version": 99}\n')
+    try:
+        load_snapshot(str(p))
+    except ValueError as e:
+        assert "--write-events" in str(e)
+    else:
+        raise AssertionError("expected ValueError on version mismatch")
+
+
+# ------------------------------------------- the repo's own contract gate
+
+def test_repo_contract_is_clean_against_committed_snapshot():
+    """The shipped gate: every publish site in the package (plus bench.py
+    and analysis/) matches EVENT_SCHEMAS and the committed
+    .gklint-events.json ratchet."""
+    pkg = os.path.dirname(gaussiank_sgd_tpu.__file__)
+    findings, sites, snap = run_events_check(rel_to=os.path.dirname(pkg))
+    assert findings == [], "\n".join(f.human() for f in findings)
+    assert len(sites) >= 20  # the runtime publishes from many modules
+    assert os.path.exists(default_events_path())
+    committed = load_snapshot(default_events_path())
+    assert committed == snap
+
+
+# ----------------------------------------------------------------- CLI
+
+def _cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "gaussiank_sgd_tpu.lint", *argv],
+        capture_output=True, text=True)
+
+
+def test_cli_events_json_report_shape(tmp_path):
+    out_file = tmp_path / "report.json"
+    r = _cli("events", "--json", "-o", str(out_file))
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = json.loads(r.stdout)
+    assert out["tool"] == "gklint-events"
+    assert out["counts"]["findings"] == 0
+    assert out["counts"]["sites"] == len(out["sites"])
+    assert out["snapshot"]["kinds"]
+    # the -o artifact is the same report CI uploads
+    assert json.loads(out_file.read_text())["counts"] == out["counts"]
+
+
+def test_cli_events_write_events_rebaselines(tmp_path):
+    snap_path = tmp_path / "ev.json"
+    r = _cli("events", "--events-file", str(snap_path), "--write-events")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "wrote" in r.stdout
+    data = json.loads(snap_path.read_text())
+    assert data["version"] == 1 and data["kinds"]

@@ -66,9 +66,9 @@ def test_bus_validate_mode_raises_on_schema_violation():
 
 
 def test_bus_concurrent_publishes_keep_file_order_equal_seq_order(tmp_path):
-    """The lock covers stamp+fan-out, so the JSONL file order must equal
-    seq order even with many publisher threads (the prefetch-thread
-    scenario)."""
+    """The delivery turnstile serializes fan-out in ticket order, so the
+    JSONL file order must equal seq order even with many publisher
+    threads (the prefetch-thread scenario)."""
     path = str(tmp_path / "t.jsonl")
     bus = EventBus([JSONLExporter(path)])
     n_threads, per_thread = 8, 50
@@ -86,6 +86,53 @@ def test_bus_concurrent_publishes_keep_file_order_equal_seq_order(tmp_path):
     bus.close()
     seqs = [json.loads(l)["seq"] for l in open(path)]
     assert seqs == list(range(n_threads * per_thread))
+
+
+def test_bus_fanout_runs_outside_the_bus_lock():
+    """Regression for the gklint conc-callback-under-lock finding: the
+    exporter fan-out must run with the bus lock RELEASED (a slow exporter
+    stalls later deliveries — the ordering contract — but never seq
+    assignment, attach, or set_stamp), while still delivering in strict
+    seq order across publisher threads."""
+    bus = EventBus([])
+    seen = []
+
+    class LockProbe(MemoryExporter):
+        def emit(self, record):
+            seen.append((record["seq"], bus._lock.locked()))
+            super().emit(record)
+
+    bus.attach(LockProbe())
+    n_threads, per_thread = 4, 25
+
+    def worker(i):
+        for j in range(per_thread):
+            bus.emit("skip", step=i * per_thread + j, nonfinite=0.0)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    bus.close()
+    assert [s for s, _ in seen] == list(range(n_threads * per_thread))
+    assert not any(locked for _, locked in seen), \
+        "exporter invoked while the bus lock was held"
+
+
+def test_bus_validate_failure_retires_ticket_without_wedging():
+    """A publish that fails validation has already taken a seq ticket;
+    the turnstile must retire it (seq gap, like before) instead of
+    leaving every later publisher waiting on an undelivered ticket."""
+    mem = MemoryExporter()
+    bus = EventBus([mem], validate=True)
+    bus.emit("skip", step=1, nonfinite=0.0)            # seq 0
+    with pytest.raises(ValueError, match="missing required field"):
+        bus.emit("skip", step=1)                       # seq 1, retired
+    rec = bus.emit("skip", step=2, nonfinite=0.0)      # must not deadlock
+    assert rec["seq"] == 2
+    assert [r["seq"] for r in mem.records] == [0, 2]
 
 
 # ---------------------------------------------------------------- exporters
