@@ -8,6 +8,8 @@
     python -m gaussiank_sgd_tpu.telemetry validate run.jsonl --strict
     python -m gaussiank_sgd_tpu.telemetry trace run.jsonl -o trace.json
     python -m gaussiank_sgd_tpu.telemetry health run.jsonl     # verdict
+    python -m gaussiank_sgd_tpu.telemetry merge \
+        pod/proc*/metrics.jsonl pod/supervisor.jsonl -o pod/merged.jsonl
 
 ``report`` reconstructs per-phase timing, comms-volume, compression and
 resilience summaries from the JSONL stream alone; ``validate`` schema-
@@ -16,6 +18,15 @@ resets); ``trace`` renders the stream into Chrome-trace/Perfetto JSON
 (open at ui.perfetto.dev — docs/OBSERVABILITY.md "Tracing &
 trajectory"). Exit codes: 0 ok, 1 validation problems (or, for trace
 --require-overlap, no exchange/compute overlap found), 2 usage error.
+
+``merge`` joins N per-process streams (a multi-process launcher pod —
+docs/OBSERVABILITY.md "Merged pod streams") into one stream ordered by
+``(ts, process_index)`` with per-process provenance stamped on every
+record; ``--strict`` then validates the merged output in place, so the
+CI gate is one command. Process indices come from ``--index`` (one per
+input, in order), else from a ``procNNN`` path component, else input
+position; the supervisor's own stream is ``--index -1`` territory (its
+records are live-stamped anyway).
 
 ``health`` replays the stream through the run-health monitor
 (docs/OBSERVABILITY.md "Run health") and exits by the WORST state the
@@ -30,13 +41,21 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from typing import List, Optional
 
-from .events import validate_file
+from .events import merge_streams, validate_file, validate_stream
 from .health import format_health, replay_health
 from .report import format_report, load_events, summarize
 from .tracing import build_chrome_trace, chrome_trace_overlap_pairs
+
+
+def infer_process_index(path: str, fallback: int) -> int:
+    """Process index from a ``procNNN`` path component (the launcher's
+    per-worker run-dir naming), else ``fallback`` (input position)."""
+    m = re.search(r"(?:^|[/\\_.-])proc(\d+)(?:[/\\_.-]|$)", path)
+    return int(m.group(1)) if m else fallback
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -77,6 +96,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="exit 1 unless >= 1 exchange span overlaps a "
                          "compress/compute span (the pipelining gate)")
 
+    mp = sub.add_parser(
+        "merge", help="merge per-process pod streams into one JSONL "
+                      "stream with process_index provenance")
+    mp.add_argument("inputs", nargs="+",
+                    help="per-process metrics.jsonl files (+ the "
+                         "supervisor stream)")
+    mp.add_argument("-o", "--out", required=True,
+                    help="merged output stream")
+    mp.add_argument("--index", type=int, action="append", default=None,
+                    help="process index of each input, in order "
+                         "(default: parsed from a procNNN path "
+                         "component, else input position)")
+    mp.add_argument("--strict", action="store_true",
+                    help="strict-validate the merged stream after "
+                         "writing; exit 1 on problems")
+
     hp = sub.add_parser(
         "health", help="replay a stream through the run-health monitor; "
                        "exit 0/1/2 by worst state (3 = no stream)")
@@ -90,6 +125,49 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "from analysis/artifacts/roofline.json)")
 
     args = ap.parse_args(argv)
+
+    if args.cmd == "merge":
+        indices = args.index
+        if indices is not None and len(indices) != len(args.inputs):
+            print(f"error: {len(args.inputs)} input(s) but "
+                  f"{len(indices)} --index value(s)", file=sys.stderr)
+            return 2
+        if indices is None:
+            indices = [infer_process_index(p, i)
+                       for i, p in enumerate(args.inputs)]
+        handles = []
+        try:
+            try:
+                for p in args.inputs:
+                    handles.append(open(p, "r", encoding="utf-8"))
+            except FileNotFoundError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            merged, mrep = merge_streams(handles, indices)
+        finally:
+            for fh in handles:
+                fh.close()
+        with open(args.out, "w", encoding="utf-8") as fh:
+            for rec in merged:
+                fh.write(json.dumps(rec) + "\n")
+        print(f"wrote {args.out}: {mrep.n_records} record(s) from "
+              f"{mrep.n_streams} stream(s), {mrep.n_stamped} "
+              f"provenance-stamped, {mrep.dropped_lines} torn line(s) "
+              f"dropped")
+        if args.strict:
+            srep = validate_stream((json.dumps(r) for r in merged),
+                                   strict=True)
+            for msg in srep.errors:
+                print(f"ERROR {msg}")
+            for msg in srep.warnings:
+                print(f"warn  {msg}")
+            print(("OK" if srep.ok else "FAIL")
+                  + f": {srep.n_processes} process(es), "
+                    f"{srep.seq_gaps} gap(s), "
+                    f"{srep.seq_duplicates} duplicate(s), "
+                    f"{srep.seq_resets} reset(s)")
+            return 0 if srep.ok else 1
+        return 0
 
     if args.cmd == "health":
         # worst-state exit codes 0/1/2 are this subcommand's contract,
@@ -161,6 +239,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "events": rep.events,
                 "seq_gaps": rep.seq_gaps,
                 "seq_resets": rep.seq_resets,
+                "seq_duplicates": rep.seq_duplicates,
+                "n_processes": rep.n_processes,
                 "truncated": rep.truncated,
                 "span_orphans": rep.span_orphans,
                 "span_unclosed": rep.span_unclosed,

@@ -75,6 +75,25 @@ class EventBus:
         with self._lock:
             self._stamp = fn
 
+    def add_stamp(self, fn: Callable[[], Mapping[str, Any]]) -> None:
+        """Compose ``fn`` with the currently installed stamp hook.
+
+        ``set_stamp`` is a single slot (tracing owns it in traced runs);
+        a second stamper — the multi-process launcher marking every
+        record with its ``process_index`` — must compose, not clobber.
+        Fields from the earlier hook win on key collisions, matching the
+        first-merged-wins order a producer would see. A later
+        ``set_stamp`` still replaces the whole composition (tracing's
+        ``uninstall`` clears everything at close; acceptable — no
+        records follow).
+        """
+        with self._lock:
+            prev = self._stamp
+        if prev is None:
+            self.set_stamp(fn)
+        else:
+            self.set_stamp(lambda: {**fn(), **prev()})
+
     def attach(self, exporter: Exporter) -> Exporter:
         with self._lock:
             self._exporters.append(exporter)

@@ -22,9 +22,11 @@ fields they do not know.
 
 from __future__ import annotations
 
+import heapq
 import json
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+from typing import (Any, Dict, Iterable, Iterator, List, Mapping, Optional,
+                    Sequence, Tuple)
 
 SCHEMA_VERSION = 1
 
@@ -115,6 +117,29 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
     "io_retry": EventSchema(
         required={"attempt": NUMBER, "max_retries": NUMBER,
                   "backoff_s": NUMBER, "error": STRING},
+    ),
+    # multi-process pod rig (training/launch.py; docs/RESILIENCE.md
+    # "Multi-process failure model"). ``bootstrap_retry`` is the
+    # io_retry shape applied to jax.distributed coordinator bootstrap;
+    # ``worker_lost``/``worker_relaunch`` come from the SUPERVISOR's
+    # stream (stamped process_index=-1). The lost worker's index is
+    # named ``worker`` — NOT process_index — because process_index is
+    # the publishing process's provenance stamp, and the supervisor
+    # reporting on worker 3 is not worker 3.
+    "bootstrap_retry": EventSchema(
+        required={"attempt": NUMBER, "max_retries": NUMBER,
+                  "backoff_s": NUMBER, "coordinator": STRING,
+                  "error": STRING},
+    ),
+    "worker_lost": EventSchema(
+        required={"worker": NUMBER, "reason": STRING,
+                  "generation": NUMBER},
+        optional={"exit_code": NUMBER, "heartbeat_age_s": NUMBER,
+                  "heartbeat_step": NUMBER},
+    ),
+    "worker_relaunch": EventSchema(
+        required={"generation": NUMBER, "nprocs": NUMBER,
+                  "checkpoint": STRING},
     ),
     # jax.profiler trace-session hooks (telemetry/profiler.py)
     "profile": EventSchema(
@@ -293,6 +318,8 @@ class StreamReport:
     n_stamped: int = 0          # records carrying a seq number
     seq_resets: int = 0         # seq went backwards (mixed-run file)
     seq_gaps: int = 0           # seq jumped forward (dropped records)
+    seq_duplicates: int = 0     # same seq twice (double-merged stream)
+    n_processes: int = 0        # distinct process_index values seen
     truncated: bool = False     # file ends mid-record
     # span-tree health (traced streams only; always warnings, never
     # errors — legacy non-traced streams have neither)
@@ -309,12 +336,22 @@ def validate_stream(lines: Iterable[str], strict: bool = False,
     """Validate a JSONL event stream line by line.
 
     Detects what the satellite contract asks parsers to detect: truncation
-    (a final non-JSON partial line), mixed-run files (seq resets), and
-    dropped records (seq gaps). Legacy records without seq/schema_version
-    are counted but not failed (non-strict mode).
+    (a final non-JSON partial line), mixed-run files (seq resets), dropped
+    records (seq gaps), and double-merged records (seq duplicates). Legacy
+    records without seq/schema_version are counted but not failed
+    (non-strict mode).
+
+    Cross-process aware: in a merged pod stream every record carries a
+    ``process_index`` provenance stamp, and each process numbers its own
+    seq space — so continuity is tracked PER process_index (records
+    without the stamp form their own group, which is exactly the old
+    single-stream behavior). Interleaving across processes is therefore
+    never a false gap, while a record missing from one worker's stream
+    still is.
     """
     rep = StreamReport()
-    prev_seq: Optional[int] = None
+    prev_seq_by_proc: Dict[Optional[int], int] = {}
+    seen_procs: set = set()
     last_bad_line: Optional[int] = None
     # span-tree bookkeeping: ids are resolved at END of stream because a
     # child "X" span is emitted when it CLOSES — before its still-open
@@ -364,21 +401,33 @@ def validate_stream(lines: Iterable[str], strict: bool = False,
             parent = record.get("parent_span")
             if isinstance(parent, str):
                 parent_refs.append((i, parent))
+        pidx = record.get("process_index")
+        group: Optional[int] = pidx \
+            if isinstance(pidx, int) and not isinstance(pidx, bool) else None
+        if group is not None:
+            seen_procs.add(group)
         seq = record.get("seq")
         if isinstance(seq, int) and not isinstance(seq, bool):
             rep.n_stamped += 1
-            if prev_seq is not None:
-                if seq < prev_seq:
+            prev = prev_seq_by_proc.get(group)
+            tag = f" [process {group}]" if group is not None else ""
+            if prev is not None:
+                if seq == prev:
+                    rep.seq_duplicates += 1
+                    rep.warnings.append(
+                        f"line {i}: duplicate seq {seq}{tag} "
+                        f"(record merged or published twice)")
+                elif seq < prev:
                     rep.seq_resets += 1
                     rep.warnings.append(
-                        f"line {i}: seq reset {prev_seq} -> {seq} "
+                        f"line {i}: seq reset {prev} -> {seq}{tag} "
                         f"(mixed-run file?)")
-                elif seq > prev_seq + 1:
+                elif seq > prev + 1:
                     rep.seq_gaps += 1
                     rep.warnings.append(
-                        f"line {i}: seq gap {prev_seq} -> {seq} "
-                        f"({seq - prev_seq - 1} record(s) missing)")
-            prev_seq = seq
+                        f"line {i}: seq gap {prev} -> {seq}{tag} "
+                        f"({seq - prev - 1} record(s) missing)")
+            prev_seq_by_proc[group] = seq
         elif strict and seq is None:
             pass  # already reported as a missing envelope field above
     if last_bad_line is not None:
@@ -400,9 +449,101 @@ def validate_stream(lines: Iterable[str], strict: bool = False,
         rep.warnings.append(
             f"span {sid!r} opened at line {line_no} never closed "
             f"(crashed mid-span, or a missing end())")
+    rep.n_processes = len(seen_procs)
     return rep
 
 
 def validate_file(path: str, strict: bool = False) -> StreamReport:
     with open(path, "r", encoding="utf-8") as fh:
         return validate_stream(fh, strict=strict)
+
+
+# ---------------------------------------------------------------------------
+# per-process stream merging (the `telemetry merge` subcommand's engine)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class MergeReport:
+    """What :func:`merge_streams` did (and dropped)."""
+
+    n_streams: int = 0
+    n_records: int = 0
+    dropped_lines: int = 0      # unparsable lines skipped — typically the
+                                # torn final line of a SIGKILLed worker
+    n_stamped: int = 0          # records that got provenance stamped here
+
+
+def _parsed_with_ts(lines: Iterable[str],
+                    rep: MergeReport) -> Iterator[Tuple[float, Dict[str,
+                                                                    Any]]]:
+    """Yield (sort_ts, record) per parseable line; a record without a
+    usable ``ts`` inherits the previous one in ITS stream (0.0 at start),
+    which keeps it adjacent to its neighbours instead of jumping to an
+    arbitrary merge position."""
+    last_ts = 0.0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            rep.dropped_lines += 1
+            continue
+        if not isinstance(rec, dict):
+            rep.dropped_lines += 1
+            continue
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)) and not isinstance(ts, bool):
+            last_ts = float(ts)
+        yield last_ts, rec
+
+
+def merge_streams(streams: Sequence[Iterable[str]],
+                  indices: Sequence[int],
+                  ) -> Tuple[List[Dict[str, Any]], MergeReport]:
+    """k-way merge of per-process JSONL event streams into one pod
+    stream.
+
+    Ordering key is ``(ts, process_index, arrival)``: host timestamps
+    interleave the processes (one machine, one clock — the launcher's
+    operating regime), ties break by process index, and records from the
+    SAME stream always keep their original relative order (the per-stream
+    seq contract survives the merge; cross-process seq continuity is then
+    checked per process_index by :func:`validate_stream`).
+
+    Provenance: every record is stamped ``process_index = indices[k]``
+    via setdefault — a record the worker already live-stamped keeps its
+    own value. Unparsable lines (the torn tail a SIGKILL leaves behind)
+    are dropped and counted in the report: the merged stream must
+    strict-validate even when an input was killed mid-write.
+    """
+    if len(streams) != len(indices):
+        raise ValueError(f"{len(streams)} streams but "
+                         f"{len(indices)} process indices")
+    rep = MergeReport(n_streams=len(streams))
+    heap: List[Tuple[float, int, int, int, Dict[str, Any]]] = []
+    iters: List[Iterator[Tuple[float, Dict[str, Any]]]] = []
+    positions = [0] * len(streams)
+    for sidx, lines in enumerate(streams):
+        it = _parsed_with_ts(lines, rep)
+        iters.append(it)
+        first = next(it, None)
+        if first is not None:
+            heapq.heappush(heap,
+                           (first[0], indices[sidx], sidx, 0, first[1]))
+            positions[sidx] = 1
+    merged: List[Dict[str, Any]] = []
+    while heap:
+        _ts, pidx, sidx, _pos, rec = heapq.heappop(heap)
+        if "process_index" not in rec:
+            rec["process_index"] = pidx
+            rep.n_stamped += 1
+        merged.append(rec)
+        rep.n_records += 1
+        nxt = next(iters[sidx], None)
+        if nxt is not None:
+            heapq.heappush(
+                heap, (nxt[0], pidx, sidx, positions[sidx], nxt[1]))
+            positions[sidx] += 1
+    return merged, rep

@@ -66,6 +66,11 @@ CAUSE_INSTABILITY = "instability"
 CAUSE_STEP_TIME = "step_time_regression"
 CAUSE_POLICY_THRASH = "policy_thrash"
 CAUSE_BENCH_REGRESSION = "bench_regression"
+# multi-process pod rig (training/launch.py): a worker process died
+# (supervisor's worker_lost records), or coordinator bootstrap is
+# retrying/exhausted (bootstrap_retry records)
+CAUSE_WORKER_LOST = "worker_lost"
+CAUSE_COORDINATOR_STALL = "coordinator_stall"
 
 # critical verdicts for these causes pre-arm the resilience monitor's
 # rollback (Trainer wiring). Deliberately narrow: instability's
@@ -114,6 +119,14 @@ class HealthPolicy:
     step_regression_factor: float = 1.75
     # policy_thrash: probation reverts observed in-window
     policy_revert_degraded: int = 2
+    # worker_lost: pod workers lost in-window (merged/supervisor
+    # streams). ONE is already critical — the pod stalls until the
+    # supervisor relaunches, and an unnoticed loss means the run's
+    # remaining numbers came from a smaller mesh than claimed
+    worker_lost_critical: int = 1
+    # coordinator_stall: bootstrap_retry burst in-window degrades; a
+    # retry that reached its budget (attempt >= max_retries) is critical
+    bootstrap_retry_degraded: int = 2
 
 
 def _pct(sorted_vals: List[float], q: float) -> float:
@@ -152,13 +165,15 @@ class HealthMonitor:
         # per-interval deques at tick time (the stream has no step on
         # io_retry records, so interval binning is the honest clock)
         self._pending = {"io_retry": 0, "skip": 0, "rollback": 0,
-                         "policy_revert": 0}
+                         "policy_revert": 0, "worker_lost": 0,
+                         "bootstrap_retry": 0}
         self._per_interval: Dict[str, Deque[int]] = {
             k: deque(maxlen=w) for k in self._pending}
         self._consecutive_skips = 0
         self._ef_ratio_ema: Optional[float] = None
         self._ef_recent: Deque[float] = deque(maxlen=4)
         self._quarantined = 0
+        self._bootstrap_exhausted = False
         self._bench_regressions = 0
         self._last_bench_regression: Optional[str] = None
         # verdict / incident bookkeeping
@@ -176,7 +191,8 @@ class HealthMonitor:
         event = record.get("event")
         if event == "train":
             self._ingest_train(record)
-        elif event in ("skip", "io_retry", "rollback", "policy_revert"):
+        elif event in ("skip", "io_retry", "rollback", "policy_revert",
+                       "worker_lost", "bootstrap_retry"):
             with self._lock:
                 self._pending[event] += 1
                 if event == "skip":
@@ -186,6 +202,13 @@ class HealthMonitor:
                 elif event == "policy_revert" \
                         and record.get("quarantined"):
                     self._quarantined += 1
+                elif event == "bootstrap_retry":
+                    # the retry carrying attempt == max_retries is the
+                    # last one before the bootstrap gives up and raises
+                    att = _num(record, "attempt")
+                    mx = _num(record, "max_retries")
+                    if att is not None and mx is not None and att >= mx:
+                        self._bootstrap_exhausted = True
         elif event == "bench_regression":
             with self._lock:
                 if record.get("status") == "regressed":
@@ -357,6 +380,22 @@ class HealthMonitor:
                 flag(CAUSE_POLICY_THRASH, DEGRADED, reverts=reverts,
                      quarantined=self._quarantined)
 
+            # worker_lost: a pod worker died (supervisor stream). One is
+            # already critical — the mesh is gone until relaunch
+            lost = sum(self._per_interval["worker_lost"])
+            if lost >= p.worker_lost_critical:
+                flag(CAUSE_WORKER_LOST, CRITICAL, workers_lost=lost)
+
+            # coordinator_stall: bootstrap retries burst (degraded) or
+            # a worker burned its whole retry budget (critical)
+            boots = sum(self._per_interval["bootstrap_retry"])
+            if self._bootstrap_exhausted:
+                flag(CAUSE_COORDINATOR_STALL, CRITICAL,
+                     bootstrap_retries=boots, retries_exhausted=True)
+            elif boots >= p.bootstrap_retry_degraded:
+                flag(CAUSE_COORDINATOR_STALL, DEGRADED,
+                     bootstrap_retries=boots)
+
             # bench_regression: the sentinel flagged this tree — a
             # standing caution for the rest of the run
             if self._bench_regressions > 0:
@@ -468,6 +507,13 @@ def replay_health(events: Iterable[Mapping[str, Any]],
         if event == "train":
             step = _num(rec, "step")
             prev_step = int(step) if step is not None else prev_step + 1
+            out.append(mon.tick(prev_step))
+        elif event == "worker_lost":
+            # supervisor streams have no train cadence of their own, and
+            # a killed pod may end right here — tick so the incident is
+            # attributed even with no later train record to bin it.
+            # No live/replay divergence: worker_lost only exists in
+            # supervisor/merged streams, which never had a live monitor
             out.append(mon.tick(prev_step))
     return out, mon
 

@@ -1,7 +1,40 @@
 """Trainer runtime (reference parity: ``dl_trainer.py`` + entry scripts —
-SURVEY.md §2 C5/C6/C10/C11)."""
+SURVEY.md §2 C5/C6/C10/C11).
 
-from .config import TrainConfig, add_args, from_args
-from .trainer import Trainer
+Lazy exports (PEP 562): importing this package must NOT import the
+Trainer eagerly — ``trainer``'s import chain initializes the jax CPU
+backend, and a multi-process pod worker (``training/launch.py``) has to
+run ``jax.distributed.initialize`` BEFORE any backend exists (jax
+refuses otherwise). ``python -m gaussiank_sgd_tpu.training.launch``
+imports this package on the way to the launch module, so the eager
+``from .trainer import Trainer`` here was exactly the forbidden
+pre-bootstrap backend init. The public surface is unchanged:
+``from gaussiank_sgd_tpu.training import Trainer`` still works — it just
+resolves at first attribute access. (Pure-stdlib consumers — config
+parsing, the telemetry CLI, the supervisor — also stop paying the jax
+import as a side benefit.)
+"""
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:            # static analyzers see the eager imports
+    from .config import TrainConfig, add_args, from_args  # noqa: F401
+    from .trainer import Trainer                          # noqa: F401
 
 __all__ = ["TrainConfig", "Trainer", "add_args", "from_args"]
+
+_LAZY = {"TrainConfig": "config", "add_args": "config",
+         "from_args": "config", "Trainer": "trainer"}
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(f".{target}", __name__), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

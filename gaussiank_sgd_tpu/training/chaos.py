@@ -12,6 +12,17 @@ Three fault families, mirroring the failure model in docs/RESILIENCE.md:
   garbage-fills, or unseals a saved checkpoint dir, the three on-disk
   states a preempted/bit-rotted save can leave behind
   (training/checkpoint.py must skip or fall back);
+* **process death** — :func:`inject_process_death` SIGKILLs the worker's
+  own process the moment the batch feeding a configured global step is
+  pulled: no cleanup, no ``atexit``, no sealed checkpoint — the real
+  pod-scale failure the multi-process supervisor
+  (training/launch.py) must detect and recover from. Keyed on global
+  step like NaN injection, so two runs of the same config die at the
+  identical stream position;
+* **coordinator faults** — :class:`FlakyCoordinator` stands in for
+  ``jax.distributed.initialize`` and refuses the first K connection
+  attempts, driving ``bootstrap_distributed``'s retry/backoff path to
+  either success or loud exhaustion without a real network;
 * **transient loader errors** — :class:`FlakyIterator` raises
   :class:`TransientIOError` on configured pulls while staying resumable
   (unit-level injection against ``data_lib.prefetch``), and
@@ -26,7 +37,8 @@ chaos test failure reproduces bit-for-bit.
 from __future__ import annotations
 
 import os
-from typing import Iterable, Iterator, Sequence, Set
+import signal
+from typing import Callable, Iterable, Iterator, Optional, Sequence, Set
 
 import numpy as np
 
@@ -106,6 +118,70 @@ def inject_nan_batches(trainer, steps: Iterable[int], once: bool = True,
     trainer._stream = _PoisonedStream
     trainer._invalidate_data_iter()
     return fired
+
+
+def inject_process_death(trainer, step: int,
+                         signum: int = signal.SIGKILL) -> None:
+    """SIGKILL this worker's own process when the batch feeding global
+    ``step`` is pulled.
+
+    Same stream-wrapping shape as :func:`inject_nan_batches` — keyed on
+    the *global* step counter carried by the wrapper, so the death point
+    is deterministic and replays bit-for-bit across runs of the same
+    config (the prefetch thread pulls ahead of the train loop, so the
+    key is the stream position feeding ``step``, which is itself
+    deterministic; wall-clock and scheduler jitter cannot move it).
+    ``signum`` defaults to real ``SIGKILL``: no handler runs, nothing is
+    sealed — the supervisor's exit-code/heartbeat detection and
+    relaunch-from-last-sealed-checkpoint path is the only way back.
+    """
+    target = int(step)
+    orig = trainer._stream
+
+    class _DoomedStream:
+        def __init__(self):
+            self._inner = orig()
+            self._step = trainer.step
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            batch = next(self._inner)   # may raise + be retried; _step
+            s = self._step              # only advances on success
+            self._step += 1
+            if s == target:
+                os.kill(os.getpid(), signum)
+            return batch
+
+    trainer._stream = _DoomedStream
+    trainer._invalidate_data_iter()
+
+
+class FlakyCoordinator:
+    """Injectable ``jax.distributed.initialize`` stand-in that refuses
+    the first ``refusals`` connection attempts (``ConnectionRefusedError``,
+    what a not-yet-listening or dead coordinator surfaces as), then
+    succeeds — or keeps refusing forever with ``refusals < 0``. Drives
+    ``training.launch.bootstrap_distributed`` through retry-to-success
+    and loud-exhaustion without a real network; ``calls`` records how
+    many attempts reached the coordinator.
+    """
+
+    def __init__(self, refusals: int,
+                 inner: Optional[Callable[[], None]] = None):
+        self.refusals = int(refusals)
+        self.calls = 0
+        self._inner = inner
+
+    def __call__(self) -> None:
+        self.calls += 1
+        if self.refusals < 0 or self.calls <= self.refusals:
+            raise ConnectionRefusedError(
+                f"injected coordinator refusal "
+                f"(attempt {self.calls}/{self.refusals})")
+        if self._inner is not None:
+            self._inner()
 
 
 class FlakyIterator:

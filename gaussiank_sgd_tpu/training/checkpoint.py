@@ -93,10 +93,26 @@ def save_checkpoint(ckpt_dir: str, state: TrainState,
     """
     step = int(jax.device_get(state.step))
     path = os.path.join(os.path.abspath(ckpt_dir), f"step_{step:08d}")
+    # multi-process pods (training/launch.py) share ckpt_dir and every
+    # process calls save_checkpoint; host-side mutations of the shared
+    # dir — clearing a stale dir, sealing the manifest — are process-0
+    # duties (concurrent rmtree/os.walk of the same tree tear each
+    # other). Single-process runs: process_index() == 0, same path as
+    # always.
+    primary = jax.process_index() == 0
     if os.path.exists(path):
         if is_committed(path) and not overwrite:
             return path
-        shutil.rmtree(path)
+        if primary:
+            shutil.rmtree(path)
+        else:
+            deadline = time.time() + 60.0
+            while os.path.exists(path):
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        f"save_checkpoint: stale dir {path} not cleared "
+                        f"by process 0 within 60s")
+                time.sleep(0.05)
     p = num_workers or _dp_width(state)
     if not p:
         raise ValueError(
@@ -127,7 +143,8 @@ def save_checkpoint(ckpt_dir: str, state: TrainState,
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, state._replace(ef_residual=ef))
     ckptr.wait_until_finished()
-    _write_manifest(path, step)
+    if primary:
+        _write_manifest(path, step)
     return path
 
 
@@ -139,7 +156,9 @@ def _write_manifest(path: str, step: int) -> None:
     inv = {}
     for root, _dirs, files in os.walk(path):
         for f in files:
-            if f == MANIFEST:
+            # the manifest itself AND its tmp name: the walk must never
+            # inventory a file the commit rename is about to remove
+            if f in (MANIFEST, MANIFEST + ".tmp"):
                 continue
             fp = os.path.join(root, f)
             inv[os.path.relpath(fp, path)] = os.path.getsize(fp)

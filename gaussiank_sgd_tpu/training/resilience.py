@@ -201,6 +201,16 @@ class GracefulShutdown:
         self._old: dict = {}
 
     def install(self) -> "GracefulShutdown":
+        # checked up front, not left to signal.signal's mid-loop raise:
+        # failing after the first handler swap would leave the process
+        # half-installed. "Main thread" means of THIS process — the
+        # multi-process launcher gives every worker its own process
+        # precisely so each one can install its own handlers
+        # (training/launch.py forwards the supervisor's SIGTERM to them)
+        if threading.current_thread() is not threading.main_thread():
+            raise RuntimeError(
+                "GracefulShutdown.install() must run on the main thread "
+                "of its own process (CPython signal.signal restriction)")
         for sig in self.SIGNALS:
             self._old[sig] = signal.signal(sig, self._handler)
         return self

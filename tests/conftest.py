@@ -24,3 +24,11 @@ virtual_cpu.enable_compile_cache()
 import jax  # noqa: E402, F401
 
 jax.config.update("jax_enable_x64", False)
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; heavy multi-process pod tests carry the
+    # marker (plus a GKSGD_RUN_SLOW env gate for bare `pytest` runs)
+    config.addinivalue_line(
+        "markers", "slow: multi-minute multi-process tests, excluded from "
+                   "the tier-1 `-m 'not slow'` run")
