@@ -151,6 +151,29 @@ class PolicySignals:
             self._arm_n = {} if dense_n is None \
                 else {self.DENSE_ARM: dense_n}
 
+    def reset_for_geometry(self, nworkers: int) -> None:
+        """Drop every timing-derived signal after an ELASTIC mesh resize
+        (``old_nworkers`` -> ``nworkers``): per-step wall time, the
+        per-arm steady-state records INCLUDING the dense reference (the
+        dense step itself runs a different psum width now), the
+        bytes-per-step gauge (proportional to P·k), and the EF-pressure
+        window (the mass-preserving redistribution rescaled every
+        residual row, so pre-resize ratios describe tensors that no
+        longer exist). Loss/skip/rollback/health signals survive — they
+        are trajectory facts, not geometry measurements. A settle period
+        is armed exactly like ``bind_arm`` so the first post-restore
+        compile interval stays out of the fresh EMAs."""
+        del nworkers                     # documents intent; value unused
+        with self._lock:
+            self._settle_left = self._settle
+            self._step_ema = None
+            self._arm_ema = {}
+            self._arm_n = {}
+            self._ef_ratio_ema = None
+            self._ef_ratio_n = 0
+            self._ratio_recent.clear()
+            self._bytes = None
+
     def _ema(self, old: Optional[float], new: float) -> float:
         return new if old is None else self._beta * old \
             + (1.0 - self._beta) * new

@@ -141,6 +141,41 @@ EVENT_SCHEMAS: Dict[str, EventSchema] = {
         required={"generation": NUMBER, "nprocs": NUMBER,
                   "checkpoint": STRING},
     ),
+    # elastic autoscaling service (service/; docs/RESILIENCE.md "Layer
+    # 6"). ``resize_begin``/``resize_commit``/``resize_abort`` bracket
+    # one mesh-geometry change: begin when a directive is accepted,
+    # commit when the new generation armed (every worker's first
+    # heartbeat) inside the step + wall budgets, abort when the change
+    # was refused or overran and the supervisor reconciled back to the
+    # old width. ``job`` is the scheduler's job id (the run_id when a
+    # supervisor runs stand-alone). All three come from the supervisor
+    # stream (process_index=-1), like worker_lost.
+    "resize_begin": EventSchema(
+        required={"job": STRING, "reason": STRING, "from_nprocs": NUMBER,
+                  "to_nprocs": NUMBER, "generation": NUMBER},
+        optional={"step": NUMBER, "step_budget": NUMBER,
+                  "wall_budget_s": NUMBER},
+    ),
+    "resize_commit": EventSchema(
+        required={"job": STRING, "from_nprocs": NUMBER,
+                  "to_nprocs": NUMBER, "generation": NUMBER,
+                  "checkpoint": STRING, "duration_s": NUMBER},
+        optional={"steps_lost": NUMBER, "reason": STRING},
+    ),
+    "resize_abort": EventSchema(
+        required={"job": STRING, "reason": STRING, "from_nprocs": NUMBER,
+                  "to_nprocs": NUMBER, "generation": NUMBER},
+        optional={"steps_lost": NUMBER, "duration_s": NUMBER},
+    ),
+    # multi-job scheduler (service/scheduler.py): admission over one
+    # device pool and job completion, on the scheduler's own stream
+    "job_admit": EventSchema(
+        required={"job": STRING, "nprocs": NUMBER, "devices_free": NUMBER},
+    ),
+    "job_done": EventSchema(
+        required={"job": STRING, "outcome": STRING, "exit_code": NUMBER,
+                  "generations": NUMBER, "resizes": NUMBER},
+    ),
     # jax.profiler trace-session hooks (telemetry/profiler.py)
     "profile": EventSchema(
         required={"action": STRING, "step": NUMBER, "logdir": STRING},

@@ -71,6 +71,10 @@ CAUSE_BENCH_REGRESSION = "bench_regression"
 # retrying/exhausted (bootstrap_retry records)
 CAUSE_WORKER_LOST = "worker_lost"
 CAUSE_COORDINATOR_STALL = "coordinator_stall"
+# elastic service (service/): the supervisor is re-meshing the job —
+# resize_begin in-window marks the geometry as in-transition (degraded);
+# a resize_abort means the service failed to land its target width
+CAUSE_RESIZE = "resize"
 
 # critical verdicts for these causes pre-arm the resilience monitor's
 # rollback (Trainer wiring). Deliberately narrow: instability's
@@ -127,6 +131,9 @@ class HealthPolicy:
     # coordinator_stall: bootstrap_retry burst in-window degrades; a
     # retry that reached its budget (attempt >= max_retries) is critical
     bootstrap_retry_degraded: int = 2
+    # resize: any resize_begin in-window marks the mesh in-transition
+    # (degraded); this many resize_aborts is critical
+    resize_abort_critical: int = 1
 
 
 def _pct(sorted_vals: List[float], q: float) -> float:
@@ -166,7 +173,8 @@ class HealthMonitor:
         # io_retry records, so interval binning is the honest clock)
         self._pending = {"io_retry": 0, "skip": 0, "rollback": 0,
                          "policy_revert": 0, "worker_lost": 0,
-                         "bootstrap_retry": 0}
+                         "bootstrap_retry": 0, "resize_begin": 0,
+                         "resize_abort": 0}
         self._per_interval: Dict[str, Deque[int]] = {
             k: deque(maxlen=w) for k in self._pending}
         self._consecutive_skips = 0
@@ -192,7 +200,8 @@ class HealthMonitor:
         if event == "train":
             self._ingest_train(record)
         elif event in ("skip", "io_retry", "rollback", "policy_revert",
-                       "worker_lost", "bootstrap_retry"):
+                       "worker_lost", "bootstrap_retry", "resize_begin",
+                       "resize_abort"):
             with self._lock:
                 self._pending[event] += 1
                 if event == "skip":
@@ -386,6 +395,18 @@ class HealthMonitor:
             if lost >= p.worker_lost_critical:
                 flag(CAUSE_WORKER_LOST, CRITICAL, workers_lost=lost)
 
+            # resize: elastic geometry changes in-window — a transition
+            # is degraded (the mesh the numbers describe is changing
+            # under them); an aborted resize is critical (the service
+            # could not land its target width inside its budgets)
+            begun = sum(self._per_interval["resize_begin"])
+            aborted = sum(self._per_interval["resize_abort"])
+            if aborted >= p.resize_abort_critical:
+                flag(CAUSE_RESIZE, CRITICAL, resizes=begun,
+                     resize_aborts=aborted)
+            elif begun > 0:
+                flag(CAUSE_RESIZE, DEGRADED, resizes=begun)
+
             # coordinator_stall: bootstrap retries burst (degraded) or
             # a worker burned its whole retry budget (critical)
             boots = sum(self._per_interval["bootstrap_retry"])
@@ -508,11 +529,11 @@ def replay_health(events: Iterable[Mapping[str, Any]],
             step = _num(rec, "step")
             prev_step = int(step) if step is not None else prev_step + 1
             out.append(mon.tick(prev_step))
-        elif event == "worker_lost":
+        elif event in ("worker_lost", "resize_begin", "resize_abort"):
             # supervisor streams have no train cadence of their own, and
             # a killed pod may end right here — tick so the incident is
             # attributed even with no later train record to bin it.
-            # No live/replay divergence: worker_lost only exists in
+            # No live/replay divergence: these kinds only exist in
             # supervisor/merged streams, which never had a live monitor
             out.append(mon.tick(prev_step))
     return out, mon
@@ -546,9 +567,21 @@ class HealthServer:
     textfile's contents when one is configured, else a minimal
     health-only exposition). Runs on a daemon thread; ``port=0`` binds
     an ephemeral port (tests), readable via :attr:`port` after
-    :meth:`start`."""
+    :meth:`start`.
 
-    def __init__(self, monitor: HealthMonitor, port: int = 0,
+    **Per-job routing** (multi-job scheduler, service/scheduler.py):
+    :meth:`add_job` registers a job id -> monitor mapping and the server
+    additionally routes ``/healthz/<job>`` and ``/metrics/<job>`` to
+    that job's monitor (404 for unknown ids). ``monitor=None`` runs the
+    server in scheduler mode: the bare ``/healthz`` then aggregates the
+    worst state across registered jobs (with every job's status inline)
+    instead of serving a single run. Single-monitor construction is
+    unchanged — existing ``--health-port`` behavior is byte-identical
+    until the first ``add_job``.
+    """
+
+    def __init__(self, monitor: Optional[HealthMonitor] = None,
+                 port: int = 0,
                  host: str = "127.0.0.1",
                  prom_path: Optional[str] = None):
         self.monitor = monitor
@@ -557,11 +590,43 @@ class HealthServer:
         self.prom_path = prom_path
         self._server = None
         self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self._jobs: Dict[str, HealthMonitor] = {}
+
+    # -- per-job routing table (HTTP threads read, scheduler writes) ----
+    def add_job(self, job: str, monitor: HealthMonitor) -> None:
+        """Serve ``/healthz/<job>`` and ``/metrics/<job>`` from this
+        monitor (replaces an existing registration of the same id)."""
+        with self._lock:
+            self._jobs[str(job)] = monitor
+
+    def remove_job(self, job: str) -> None:
+        with self._lock:
+            self._jobs.pop(str(job), None)
+
+    def _job_monitor(self, job: str) -> Optional[HealthMonitor]:
+        with self._lock:
+            return self._jobs.get(job)
+
+    def _jobs_view(self) -> Dict[str, HealthMonitor]:
+        with self._lock:
+            return dict(self._jobs)
+
+    def _root_status(self) -> Dict[str, Any]:
+        """The bare ``/healthz`` body: the default monitor's status, or
+        (scheduler mode) the worst-across-jobs aggregate."""
+        if self.monitor is not None:
+            return self.monitor.status()
+        jobs = {name: mon.status()
+                for name, mon in sorted(self._jobs_view().items())}
+        worst = max((s["state_code"] for s in jobs.values()), default=OK)
+        return {"state": STATE_NAMES[worst], "state_code": worst,
+                "jobs": jobs}
 
     def start(self) -> "HealthServer":
         from http.server import BaseHTTPRequestHandler, \
             ThreadingHTTPServer
-        monitor, prom_path = self.monitor, self.prom_path
+        server, prom_path = self, self.prom_path
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # noqa: A003
@@ -575,16 +640,23 @@ class HealthServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_status(self, status: Dict[str, Any]) -> None:
+                code = 503 if status["state_code"] >= CRITICAL else 200
+                self._send(code,
+                           json.dumps(status, default=float,
+                                      indent=2).encode(),
+                           "application/json")
+
             def do_GET(self):   # noqa: N802 (stdlib handler contract)
                 path = self.path.split("?", 1)[0]
                 if path in ("/", "/healthz"):
-                    status = monitor.status()
-                    code = 503 if status["state_code"] >= CRITICAL \
-                        else 200
-                    self._send(code,
-                               json.dumps(status, default=float,
-                                          indent=2).encode(),
-                               "application/json")
+                    self._send_status(server._root_status())
+                elif path.startswith("/healthz/"):
+                    mon = server._job_monitor(path[len("/healthz/"):])
+                    if mon is None:
+                        self._send(404, b"unknown job\n", "text/plain")
+                    else:
+                        self._send_status(mon.status())
                 elif path == "/metrics":
                     text = None
                     if prom_path:
@@ -595,11 +667,31 @@ class HealthServer:
                         except OSError:
                             text = None
                     if text is None:
-                        s = monitor.status()
-                        text = (f"health_state "
-                                f"{s['worst_state_code']}\n")
+                        lines = []
+                        if server.monitor is not None:
+                            s = server.monitor.status()
+                            lines.append(f"health_state "
+                                         f"{s['worst_state_code']}")
+                        for name, mon in sorted(
+                                server._jobs_view().items()):
+                            s = mon.status()
+                            lines.append(
+                                f'health_state{{job="{name}"}} '
+                                f"{s['worst_state_code']}")
+                        text = ("\n".join(lines) + "\n") if lines \
+                            else "health_state 0\n"
                     self._send(200, text.encode(),
                                "text/plain; version=0.0.4")
+                elif path.startswith("/metrics/"):
+                    mon = server._job_monitor(path[len("/metrics/"):])
+                    if mon is None:
+                        self._send(404, b"unknown job\n", "text/plain")
+                    else:
+                        s = mon.status()
+                        self._send(200,
+                                   f"health_state "
+                                   f"{s['worst_state_code']}\n".encode(),
+                                   "text/plain; version=0.0.4")
                 else:
                     self._send(404, b"not found\n", "text/plain")
 

@@ -19,6 +19,11 @@ Three fault families, mirroring the failure model in docs/RESILIENCE.md:
   (training/launch.py) must detect and recover from. Keyed on global
   step like NaN injection, so two runs of the same config die at the
   identical stream position;
+* **graceful preemption** — :func:`inject_preemption` SIGTERMs the
+  worker's own process at a configured step instead: GracefulShutdown
+  seals a checkpoint and the worker exits 0 while its peers are still
+  live — the capacity-preemption drain the elastic service
+  (``service/``) must answer with a shrink;
 * **coordinator faults** — :class:`FlakyCoordinator` stands in for
   ``jax.distributed.initialize`` and refuses the first K connection
   attempts, driving ``bootstrap_distributed``'s retry/backoff path to
@@ -155,6 +160,47 @@ def inject_process_death(trainer, step: int,
             return batch
 
     trainer._stream = _DoomedStream
+    trainer._invalidate_data_iter()
+
+
+def inject_preemption(trainer, step: int,
+                      signum: int = signal.SIGTERM) -> None:
+    """SIGTERM this worker's own process when the batch feeding global
+    ``step`` is pulled — the graceful twin of
+    :func:`inject_process_death`, modelling a capacity preemption notice
+    rather than a crash.
+
+    Same deterministic stream-keyed trigger, but the default ``SIGTERM``
+    lands on the worker's installed :class:`GracefulShutdown` handler:
+    the trainer finishes the in-flight step, SEALS a checkpoint at the
+    boundary, publishes ``preempt``, and exits 0. That is exactly the
+    drain the elastic service's resize engine must notice (a clean exit
+    while peers are still live) and answer with a shrink — the
+    chaos-testable entry into the graceful-drain path
+    (``service.ElasticSupervisor``). Wired to ``--preempt-step`` /
+    ``--preempt-proc`` on the launcher CLI (generation 0 only, like
+    ``--kill-step``).
+    """
+    target = int(step)
+    orig = trainer._stream
+
+    class _PreemptedStream:
+        def __init__(self):
+            self._inner = orig()
+            self._step = trainer.step
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            batch = next(self._inner)   # may raise + be retried; _step
+            s = self._step              # only advances on success
+            self._step += 1
+            if s == target:
+                os.kill(os.getpid(), signum)
+            return batch
+
+    trainer._stream = _PreemptedStream
     trainer._invalidate_data_iter()
 
 

@@ -235,7 +235,8 @@ def gc_checkpoints(ckpt_dir: str, keep_last: int) -> List[str]:
 
 def restore_checkpoint(path: str, target: TrainState,
                        mesh: Optional[Mesh] = None,
-                       padded_numel: Optional[int] = None) -> TrainState:
+                       padded_numel: Optional[int] = None,
+                       on_elastic=None) -> TrainState:
     """Restore into the structure of ``target`` with live mesh shardings.
 
     ``padded_numel``: the live per-worker EF row size when the target run
@@ -288,6 +289,12 @@ def restore_checkpoint(path: str, target: TrainState,
         n_row = n_flat
     pad = n_row - n_flat
     new_p = int(target.ef_residual.size) // n_row if n_row else 0
+    if on_elastic is not None and old_p != new_p:
+        # the caller learns the geometry change BEFORE the restore does
+        # any work — the elastic service resets geometry-derived policy
+        # signals here (a raise aborts the restore, so a refusing
+        # callback can veto an unexpected width change)
+        on_elastic(old_p, new_p)
     if pad < 0 or new_p < 1 or new_p * n_row != target.ef_residual.size:
         # user-facing artifact validation: a bare assert would vanish
         # under -O and silently mis-redistribute mass (code-review r4)
@@ -481,7 +488,8 @@ def restore_latest_good(ckpt_dir: str, target: TrainState,
                         mesh: Optional[Mesh] = None,
                         on_skip=None,
                         before_step: Optional[int] = None,
-                        padded_numel: Optional[int] = None
+                        padded_numel: Optional[int] = None,
+                        on_elastic=None
                         ) -> Tuple[TrainState, str]:
     """Restore the newest checkpoint that actually restores.
 
@@ -504,6 +512,10 @@ def restore_latest_good(ckpt_dir: str, target: TrainState,
     mismatches (different model, flat-opt vs optax) raise the same way and
     also fall through — the final RuntimeError carries every per-candidate
     cause so a genuine config error is still diagnosable.
+
+    ``on_elastic(old_p, new_p)`` forwards to ``restore_checkpoint`` —
+    called when the candidate was written by a different worker count
+    (the elastic-resize restore path).
     """
     ckpts = list_checkpoints(ckpt_dir)
     if before_step is not None:
@@ -519,7 +531,8 @@ def restore_latest_good(ckpt_dir: str, target: TrainState,
     for _step, path in reversed(ckpts):
         try:
             return restore_checkpoint(path, target, mesh,
-                                      padded_numel=padded_numel), path
+                                      padded_numel=padded_numel,
+                                      on_elastic=on_elastic), path
         except Exception as e:  # noqa: BLE001 — see docstring
             causes.append(f"{os.path.basename(path)}: {type(e).__name__}: "
                           f"{e}")

@@ -71,6 +71,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 SPEC_ENV = "GKSGD_LAUNCH_SPEC"
 KILL_STEP_ENV = "GKSGD_CHAOS_KILL_STEP"
 KILL_PROC_ENV = "GKSGD_CHAOS_KILL_PROC"
+PREEMPT_STEP_ENV = "GKSGD_CHAOS_PREEMPT_STEP"
+PREEMPT_PROC_ENV = "GKSGD_CHAOS_PREEMPT_PROC"
 
 # manifest name duplicated from training/checkpoint.py so the supervisor
 # never imports jax/orbax (checked against it in tests/test_launch.py)
@@ -310,6 +312,10 @@ def worker_main(spec: Dict[str, Any], process_id: int) -> int:
     if kill_step is not None \
             and int(os.environ.get(KILL_PROC_ENV, "0")) == process_id:
         chaos.inject_process_death(trainer, int(kill_step))
+    preempt_step = os.environ.get(PREEMPT_STEP_ENV)
+    if preempt_step is not None \
+            and int(os.environ.get(PREEMPT_PROC_ENV, "0")) == process_id:
+        chaos.inject_preemption(trainer, int(preempt_step))
 
     try:
         trainer.fit()
@@ -364,10 +370,24 @@ class LaunchConfig:
     kill_step: Optional[int] = None      # chaos: SIGKILL one worker when
     kill_proc: int = 0                   # it pulls the batch for this step
                                          # (generation 0 only)
+    preempt_step: Optional[int] = None   # chaos: SIGTERM one worker at a
+    preempt_proc: int = 0                # step (graceful twin; gen 0 only)
 
 
 class Supervisor:
     """Spawn/watch/teardown/relaunch loop over N worker processes.
+
+    The loop is a TARGET-N RECONCILER, not a fixed-N relauncher: the
+    width to spawn at is supervisor state (``target_nprocs``), every
+    generation's spec is built from it, and :meth:`request_resize` moves
+    it from any thread — the watch loop notices at its next poll and
+    executes teardown -> re-spec -> spawn at the new width, resuming
+    from the last sealed checkpoint through the elastic-restore path.
+    The base class accepts any width >= 1 with no ceremony; budgets,
+    bounds and ``resize_*`` telemetry live in
+    :class:`~gaussiank_sgd_tpu.service.ElasticSupervisor`, which
+    overrides the ``_poll_tick``/``_post_spawn``/``_on_worker_lost``/
+    ``_apply_resize`` hooks.
 
     Single-threaded by design: the watch loop polls, and the SIGTERM/
     SIGINT handlers only set an Event (async-signal-safe), mirroring
@@ -379,6 +399,7 @@ class Supervisor:
 
     def __init__(self, cfg, launch: LaunchConfig, pod_dir: str):
         from ..telemetry import EventBus, JSONLExporter
+        from .metrics import make_logger
         self.cfg = cfg
         self.launch = launch
         self.pod_dir = pod_dir
@@ -391,8 +412,68 @@ class Supervisor:
         self._shutdown = threading.Event()
         self._old_handlers: Dict[int, Any] = {}
         self._logs: List[Any] = []
+        self.log = make_logger("gaussiank_sgd_tpu.launch")
         self.generation = 0
         self.relaunches = 0
+        self._lock = threading.Lock()
+        self._target_nprocs = int(launch.nprocs)
+        self._resize: Optional[Tuple[int, str]] = None
+
+    # -- target-N reconciliation ---------------------------------------
+    @property
+    def target_nprocs(self) -> int:
+        with self._lock:
+            return self._target_nprocs
+
+    def request_resize(self, nprocs: int, reason: str = "operator") -> None:
+        """Thread-safe: ask the reconcile loop to re-mesh at ``nprocs``.
+        Takes effect at the next watch poll; a later request before the
+        loop consumed the previous one supersedes it."""
+        with self._lock:
+            self._resize = (max(1, int(nprocs)), str(reason))
+
+    def _resize_pending(self) -> bool:
+        with self._lock:
+            return self._resize is not None
+
+    def _take_resize(self) -> Optional[Tuple[int, str]]:
+        with self._lock:
+            out, self._resize = self._resize, None
+            return out
+
+    def _commit_target(self, nprocs: int) -> None:
+        with self._lock:
+            self._target_nprocs = max(1, int(nprocs))
+
+    # -- service hooks (no-ops here; service/ overrides) ----------------
+    def _poll_tick(self, procs: Sequence[subprocess.Popen],
+                   spec: Dict[str, Any]) -> None:
+        """Once per watch poll, before death checks."""
+
+    def _post_spawn(self, procs: Sequence[subprocess.Popen],
+                    spec: Dict[str, Any]) -> None:
+        """Right after a generation is spawned, before watching it."""
+
+    def _on_worker_lost(self, lost: List[Dict[str, Any]],
+                        spec: Dict[str, Any]) -> None:
+        """After ``worker_lost`` is published, before the relaunch
+        budget is charged."""
+
+    def _apply_resize(self, directive: Tuple[int, str],
+                      progress_step: int) -> bool:
+        """Commit a directive taken after teardown; False refuses it (the
+        loop then relaunches at the old width). The base accepts all."""
+        self._commit_target(directive[0])
+        return True
+
+    def _progress_step(self, spec: Dict[str, Any]) -> int:
+        """Highest step any worker's heartbeat reached this generation."""
+        best = 0
+        for path in spec["heartbeats"]:
+            hb = read_heartbeat(path)
+            if hb is not None:
+                best = max(best, int(hb.get("step", 0)))
+        return best
 
     # -- lifecycle ------------------------------------------------------
     def _install_signals(self) -> None:
@@ -402,20 +483,27 @@ class Supervisor:
             self._old_handlers[sig] = signal.signal(
                 sig, lambda _s, _f: self._shutdown.set())
 
+    def stop(self) -> None:
+        """Request a graceful end of the run (what SIGTERM does); safe
+        from any thread — the watch loop notices at its next poll."""
+        self._shutdown.set()
+
     def _uninstall_signals(self) -> None:
         for sig, old in self._old_handlers.items():
             signal.signal(sig, old)
         self._old_handlers.clear()
 
-    def _worker_spec(self, resume: Optional[str]) -> Dict[str, Any]:
+    def _worker_spec(self, resume: Optional[str],
+                     nprocs: Optional[int] = None) -> Dict[str, Any]:
+        n = int(nprocs) if nprocs is not None else self.target_nprocs
         hb_dir = os.path.join(self.pod_dir, "heartbeats")
         return {
             "coordinator": f"127.0.0.1:{free_port()}",
-            "nprocs": self.launch.nprocs,
+            "nprocs": n,
             "pod_dir": self.pod_dir,
             "ckpt_dir": self.ckpt_dir,
             "heartbeats": [os.path.join(hb_dir, f"proc{i:03d}.json")
-                           for i in range(self.launch.nprocs)],
+                           for i in range(n)],
             "resume": resume,
             "bootstrap_timeout_s": self.launch.bootstrap_timeout_s,
             "bootstrap_retries": self.launch.bootstrap_retries,
@@ -425,14 +513,24 @@ class Supervisor:
 
     def _spawn(self, spec: Dict[str, Any]) -> List[subprocess.Popen]:
         # stale heartbeats from the previous generation must not trip
-        # the staleness detector before the new workers' first beat
-        for hb in spec["heartbeats"]:
-            if os.path.exists(hb):
-                os.remove(hb)
+        # the staleness detector before the new workers' first beat —
+        # glob the whole dir: after a shrink, the dropped workers' files
+        # are not in this spec but would still look live to _progress_step
+        hb_dir = os.path.dirname(spec["heartbeats"][0])
+        if os.path.isdir(hb_dir):
+            for name in os.listdir(hb_dir):
+                if name.startswith("proc") and name.endswith(".json"):
+                    os.remove(os.path.join(hb_dir, name))
+        n = int(spec["nprocs"])
+        self.log.info(
+            "SPAWN gen %d: nprocs=%d heartbeat_timeout=%.1fs "
+            "poll_interval=%.2fs grace=%.1fs coordinator=%s",
+            self.generation, n, self.launch.heartbeat_timeout_s,
+            self.launch.poll_s, self.launch.grace_s, spec["coordinator"])
         procs = []
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
-        for i in range(self.launch.nprocs):
+        for i in range(n):
             env = dict(os.environ)
             env[SPEC_ENV] = json.dumps(spec)
             env["PYTHONPATH"] = pkg_root + os.pathsep \
@@ -443,6 +541,13 @@ class Supervisor:
             else:
                 env.pop(KILL_STEP_ENV, None)
                 env.pop(KILL_PROC_ENV, None)
+            if self.generation == 0 \
+                    and self.launch.preempt_step is not None:
+                env[PREEMPT_STEP_ENV] = str(self.launch.preempt_step)
+                env[PREEMPT_PROC_ENV] = str(self.launch.preempt_proc)
+            else:
+                env.pop(PREEMPT_STEP_ENV, None)
+                env.pop(PREEMPT_PROC_ENV, None)
             log = open(os.path.join(
                 self.pod_dir,
                 f"gen{self.generation:02d}_proc{i:03d}.log"), "w")
@@ -480,6 +585,9 @@ class Supervisor:
         while True:
             if self._shutdown.is_set():
                 return "shutdown", []
+            self._poll_tick(procs, spec)
+            if self._resize_pending():
+                return "resize", []
             lost = self._lost_workers(procs, spec, time.time())
             if lost:
                 return "lost", lost
@@ -515,9 +623,11 @@ class Supervisor:
                 spec = self._worker_spec(
                     resume=self.ckpt_dir if resume else None)
                 procs = self._spawn(spec)
+                self._post_spawn(procs, spec)
                 outcome, lost = self._watch(procs, spec)
                 if outcome == "ok":
                     return 0
+                progress = self._progress_step(spec)
                 self._teardown(procs)
                 if outcome == "shutdown":
                     return 143           # 128 + SIGTERM, shell convention
@@ -525,18 +635,27 @@ class Supervisor:
                     self.bus.publish({"event": "worker_lost",
                                       "generation": self.generation,
                                       **rec})
-                self.relaunches += 1
-                if self.relaunches > self.launch.max_relaunches:
-                    raise RuntimeError(
-                        f"relaunch budget exhausted "
-                        f"({self.launch.max_relaunches}): workers keep "
-                        f"dying — see {self.pod_dir}/gen*_proc*.log and "
-                        f"supervisor.jsonl (docs/RESILIENCE.md)")
+                if outcome == "lost":
+                    self._on_worker_lost(lost, spec)
+                    self.relaunches += 1
+                    if self.relaunches > self.launch.max_relaunches:
+                        raise RuntimeError(
+                            f"relaunch budget exhausted "
+                            f"({self.launch.max_relaunches}): workers keep "
+                            f"dying — see {self.pod_dir}/gen*_proc*.log and "
+                            f"supervisor.jsonl (docs/RESILIENCE.md)")
+                # a directive may have arrived via the watch interrupt OR
+                # from _on_worker_lost (loss-driven shrink): either way it
+                # is applied exactly once, after teardown, so the next
+                # spawn reconciles straight to the new width
+                directive = self._take_resize()
+                if directive is not None:
+                    self._apply_resize(directive, progress)
                 self.generation += 1
                 sealed = has_sealed_checkpoint(self.ckpt_dir)
                 self.bus.publish({"event": "worker_relaunch",
                                   "generation": self.generation,
-                                  "nprocs": self.launch.nprocs,
+                                  "nprocs": self.target_nprocs,
                                   "checkpoint": sealed or ""})
         finally:
             self._uninstall_signals()
@@ -565,6 +684,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     dest="heartbeat_timeout_s",
                     help="seconds of heartbeat silence before a live "
                          "worker counts as lost (hang backstop)")
+    ap.add_argument("--poll-interval", type=float, default=0.2,
+                    dest="poll_s",
+                    help="supervisor watch-loop poll period (s); also "
+                         "the teardown escalation poll")
     ap.add_argument("--grace", type=float, default=20.0, dest="grace_s",
                     help="SIGTERM->SIGKILL escalation window (s)")
     ap.add_argument("--max-relaunches", type=int, default=2)
@@ -575,6 +698,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="chaos: SIGKILL --kill-proc when it pulls the "
                          "batch feeding this global step (gen 0 only)")
     ap.add_argument("--kill-proc", type=int, default=0)
+    ap.add_argument("--preempt-step", type=int, default=None,
+                    help="chaos: SIGTERM --preempt-proc (graceful "
+                         "preemption) at this global step (gen 0 only)")
+    ap.add_argument("--preempt-proc", type=int, default=0)
     config_mod.add_args(ap)
     args = ap.parse_args(argv)
     cfg = config_mod.from_args(args, argv)
@@ -582,10 +709,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     launch = LaunchConfig(
         nprocs=args.nprocs,
         heartbeat_timeout_s=args.heartbeat_timeout_s,
-        grace_s=args.grace_s, max_relaunches=args.max_relaunches,
+        grace_s=args.grace_s, poll_s=args.poll_s,
+        max_relaunches=args.max_relaunches,
         bootstrap_timeout_s=args.bootstrap_timeout_s,
         bootstrap_retries=args.bootstrap_retries,
-        kill_step=args.kill_step, kill_proc=args.kill_proc)
+        kill_step=args.kill_step, kill_proc=args.kill_proc,
+        preempt_step=args.preempt_step, preempt_proc=args.preempt_proc)
     pod_dir = os.path.join(cfg.output_dir, cfg.run_id)
     return Supervisor(cfg, launch, pod_dir).run()
 

@@ -302,14 +302,16 @@ class Trainer:
             if os.path.basename(cfg.resume).startswith("step_"):
                 self.state = restore_checkpoint(
                     cfg.resume, self.state, self.mesh,
-                    padded_numel=self.ts.ef_numel)
+                    padded_numel=self.ts.ef_numel,
+                    on_elastic=self._on_elastic_restore)
                 path = cfg.resume
             else:
                 try:
                     self.state, path = restore_latest_good(
                         cfg.resume, self.state, self.mesh,
                         on_skip=self._log_restore_skip,
-                        padded_numel=self.ts.ef_numel)
+                        padded_numel=self.ts.ef_numel,
+                        on_elastic=self._on_elastic_restore)
                 except FileNotFoundError:
                     # nothing committed yet (fresh run dir) — start cold,
                     # same as the pre-resilience behavior
@@ -483,6 +485,20 @@ class Trainer:
                             path, type(exc).__name__, exc)
         self.bus.publish({"event": "restore_fallback", "checkpoint": path,
                           "error": f"{type(exc).__name__}: {exc}"})
+
+    def _on_elastic_restore(self, old_p: int, new_p: int) -> None:
+        """The checkpoint being restored was written at a different
+        worker count (elastic resize, service/): log the geometry change
+        and drop the policy engine's geometry-derived signals — step-time
+        and per-arm EMAs, bytes/step, EF-pressure window — so decisions
+        after the re-mesh are never anchored on measurements of a mesh
+        that no longer exists (policy/signals.py reset_for_geometry)."""
+        self.logger.info(
+            "elastic restore: checkpoint written at %d worker(s), "
+            "resuming at %d — EF mass redistributed, carry reset, "
+            "geometry-derived policy signals dropped", old_p, new_p)
+        if self.engine is not None:
+            self.engine.signals.reset_for_geometry(new_p)
 
     def _rollback(self, reason: str) -> None:
         """Automatic divergence recovery (docs/RESILIENCE.md): restore the

@@ -85,6 +85,18 @@ def test_bootstrap_exhaustion_fails_loud_with_attempt_log():
     assert "ConnectionRefusedError" in msg
 
 
+def test_bootstrap_exhaustion_attempt_log_is_complete_and_ordered():
+    # "full attempt log": every attempt appears, in order, each with its
+    # own error — not just the first and last (ISSUE 18 satellite)
+    with pytest.raises(RuntimeError) as ei:
+        _bootstrap(-1, max_retries=3)
+    lines = [ln.strip() for ln in str(ei.value).splitlines()
+             if ln.strip().startswith("attempt ")]
+    assert len(lines) == 4                           # max_retries + 1
+    assert [int(ln.split()[1].rstrip(":")) for ln in lines] == [1, 2, 3, 4]
+    assert all("ConnectionRefusedError" in ln for ln in lines)
+
+
 def test_bootstrap_retry_event_validates_on_a_strict_bus():
     _a, _s, events, _fc = _bootstrap(1, max_retries=2)
     mem = MemoryExporter()
@@ -141,6 +153,34 @@ def test_process_death_fires_on_exact_stream_position_twice():
     assert _pulls_until_signal(3, 5) == 3
     assert _pulls_until_signal(3, 5) == 3
     assert _pulls_until_signal(0, 7) == 8
+
+
+def _pulls_until_preempt(start_step, target):
+    hits = []
+    old = signal.signal(signal.SIGUSR1, lambda _s, _f: hits.append(True))
+    try:
+        t = _FakeTrainer(step=start_step)
+        chaos.inject_preemption(t, target, signum=signal.SIGUSR1)
+        assert t.invalidated == 1
+        it = t._stream()
+        pulls = 0
+        while not hits:
+            next(it)
+            pulls += 1
+        return pulls
+    finally:
+        signal.signal(signal.SIGUSR1, old)
+
+
+def test_inject_preemption_fires_on_exact_stream_position_twice():
+    # the graceful twin of inject_process_death: same step keying, same
+    # determinism — only the delivered signal differs (SIGTERM, so the
+    # worker's GracefulShutdown seals and exits 0)
+    assert _pulls_until_preempt(3, 5) == 3
+    assert _pulls_until_preempt(3, 5) == 3
+    assert _pulls_until_preempt(0, 7) == 8
+    # and it lands on the same pull as the SIGKILL twin would
+    assert _pulls_until_preempt(2, 9) == _pulls_until_signal(2, 9)
 
 
 _DEATH_CODE = r"""
@@ -361,6 +401,41 @@ def test_cli_merge_interleaves_and_strict_validates(tmp_path, capsys):
     text = capsys.readouterr().out
     assert "7 record(s) from 3 stream(s)" in text
     assert "3 process(es)" in text
+
+
+def test_merge_streams_timestamp_ties_across_three_streams():
+    # ISSUE 18 satellite: at equal ts across >= 3 streams the merge is
+    # deterministic — ties break by process_index, and records from the
+    # same stream never reorder relative to each other
+    from gaussiank_sgd_tpu.telemetry.events import merge_streams
+
+    def stream(pidx, specs):
+        return [json.dumps({"schema_version": 1, "seq": i,
+                            "process_index": pidx, **spec})
+                for i, spec in enumerate(specs)]
+
+    s2 = stream(2, [{"ts": 1.0, "event": "skip", "step": 1,
+                     "nonfinite": 0.0},
+                    {"ts": 2.0, "event": "skip", "step": 2,
+                     "nonfinite": 0.0}])
+    s0 = stream(0, [{"ts": 1.0, "event": "skip", "step": 1,
+                     "nonfinite": 0.0},
+                    {"ts": 1.0, "event": "skip", "step": 2,
+                     "nonfinite": 0.0}])
+    s1 = stream(1, [{"ts": 1.0, "event": "skip", "step": 1,
+                     "nonfinite": 0.0},
+                    # ts-less record: inherits 1.0 from its own stream,
+                    # stays behind its predecessor
+                    {"event": "skip", "step": 2, "nonfinite": 0.0}])
+    merged, rep = merge_streams([s2, s0, s1], [2, 0, 1])
+    key = [(r["process_index"], r["seq"]) for r in merged]
+    # the five ts=1.0 records first (pidx asc, in-stream order kept),
+    # then the lone ts=2.0 record
+    assert key == [(0, 0), (0, 1), (1, 0), (1, 1), (2, 0), (2, 1)]
+    assert rep.n_records == 6 and rep.dropped_lines == 0
+    # input order of the streams argument must not matter
+    merged2, _rep2 = merge_streams([s1, s2, s0], [1, 2, 0])
+    assert [(r["process_index"], r["seq"]) for r in merged2] == key
 
 
 def test_cli_merge_usage_errors(tmp_path):

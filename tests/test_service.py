@@ -1,0 +1,698 @@
+"""Elastic autoscaling service (service/, ISSUE 18).
+
+Tier-1 part: pure-unit coverage of the service building blocks with no
+real pod — control-plane consume/torn-write, planner decision rules,
+device-pool fairness, the resize engine's accept/refuse/commit/abort
+paths against fake child handles, strict validation of every new event
+kind, per-job health routing, and the scheduler's admit/done lifecycle.
+
+Slow part (``-m slow`` + ``GKSGD_RUN_SLOW=1``): the chaos acceptance —
+a real pod surviving N=2→4→2 (worker SIGKILL mid-step plus scripted
+operator grow/shrink) with every resize inside its step budget and the
+merged-stream loss on the dense-parity band; CI runs the lighter
+N=2→3→2 smoke.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gaussiank_sgd_tpu.service import (ControlPlane, DevicePool,
+                                       ElasticSupervisor, JobScheduler,
+                                       ResizePlanner, ResizePolicy)
+from gaussiank_sgd_tpu.service import scheduler as scheduler_mod
+from gaussiank_sgd_tpu.telemetry import EventBus, MemoryExporter
+from gaussiank_sgd_tpu.telemetry.__main__ import main as telemetry_cli
+from gaussiank_sgd_tpu.telemetry.health import (CAUSE_RESIZE, CRITICAL,
+                                                HealthMonitor, HealthServer)
+from gaussiank_sgd_tpu.training import launch
+from gaussiank_sgd_tpu.training.config import TrainConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+slow = pytest.mark.slow
+run_slow = pytest.mark.skipif(
+    os.environ.get("GKSGD_RUN_SLOW") != "1",
+    reason="multi-minute multi-process pod run (set GKSGD_RUN_SLOW=1)")
+
+
+# ---------------------------------------------------------- control plane
+
+def test_control_plane_consumes_commands_once(tmp_path):
+    path = str(tmp_path / "control.json")
+    cp = ControlPlane(path)
+    assert cp.poll() == []                            # no file yet
+    ControlPlane.write(path, {"cmd": "resize", "nprocs": 4},
+                       {"cmd": "stop"})
+    assert cp.poll() == [{"cmd": "resize", "nprocs": 4}, {"cmd": "stop"}]
+    assert not os.path.exists(path)                   # consumed
+    assert cp.poll() == []
+
+
+def test_control_plane_retries_torn_write_then_rejects(tmp_path):
+    path = str(tmp_path / "control.json")
+    cp = ControlPlane(path, max_retries=2)
+    with open(path, "w") as fh:
+        fh.write('{"cmd": "resi')                     # torn mid-write
+    # left in place for max_retries polls (the writer may still finish)
+    assert cp.poll() == [] and os.path.exists(path)
+    assert cp.poll() == [] and os.path.exists(path)
+    # then consumed anyway so garbage cannot wedge the loop
+    assert cp.poll() == []
+    assert not os.path.exists(path) and cp.rejected == 1
+    # a torn write the writer DID finish parses on the retry
+    with open(path, "w") as fh:
+        fh.write('{"cmd": "st')
+    assert cp.poll() == []
+    ControlPlane.write(path, {"cmd": "stop"})
+    assert cp.poll() == [{"cmd": "stop"}]
+    assert cp.rejected == 1
+
+
+def test_control_plane_rejects_non_command_json(tmp_path):
+    path = str(tmp_path / "control.json")
+    cp = ControlPlane(path, max_retries=0)
+    with open(path, "w") as fh:
+        fh.write('[1, 2]\n')                          # valid JSON, no cmd
+    assert cp.poll() == []
+    assert cp.rejected == 1 and not os.path.exists(path)
+
+
+# ---------------------------------------------------------------- planner
+
+def test_planner_clamp_refuses_out_of_bounds():
+    pl = ResizePlanner(ResizePolicy(min_nprocs=2, max_nprocs=8))
+    assert pl.clamp(2) == 2 and pl.clamp(8) == 8
+    assert pl.clamp(1) is None and pl.clamp(9) is None
+
+
+def test_planner_drain_shrinks_to_survivors():
+    pl = ResizePlanner(ResizePolicy(min_nprocs=2))
+    d = pl.on_drain(live=3, current=4)
+    assert (d.nprocs, d.reason) == (3, "preemption")
+    assert pl.on_drain(live=4, current=4) is None
+    assert pl.on_drain(live=1, current=4).nprocs == 2   # floor wins
+
+
+def test_planner_loss_pressure_sheds_one_worker_at_budget_edge():
+    pl = ResizePlanner(ResizePolicy(min_nprocs=1,
+                                    pressure_relaunches_left=0))
+    assert pl.on_loss(current=4, relaunches_left=1) is None
+    d = pl.on_loss(current=4, relaunches_left=0)
+    assert (d.nprocs, d.reason) == (3, "relaunch_pressure")
+    assert pl.on_loss(current=1, relaunches_left=0) is None  # at floor
+
+
+def test_planner_verdict_needs_sustained_critical_streak():
+    pl = ResizePlanner(ResizePolicy(sustained_critical=2))
+    crit = {"state_code": CRITICAL, "causes": ["worker_lost"]}
+    ok = {"state_code": 0, "causes": []}
+    assert pl.on_verdict(crit, 4) is None             # one tick: incident
+    d = pl.on_verdict(crit, 4)                        # two in a row: pattern
+    assert (d.nprocs, d.reason) == (3, "health_critical")
+    # the streak resets after firing AND on any non-critical tick
+    assert pl.on_verdict(crit, 3) is None
+    assert pl.on_verdict(ok, 3) is None
+    assert pl.on_verdict(crit, 3) is None
+    # an unrelated critical cause never counts toward the streak
+    other = {"state_code": CRITICAL, "causes": ["loss_regression"]}
+    assert pl.on_verdict(other, 3) is None
+    assert pl.on_verdict(other, 3) is None
+
+
+# ------------------------------------------------------------ device pool
+
+def test_device_pool_admission_and_release():
+    pool = DevicePool(4)
+    assert pool.admit("a", 3) == 3 and pool.free == 1
+    assert pool.admit("b", 2) == 1                    # partial grant
+    assert pool.admit("c", 1) == 0                    # nothing left
+    assert pool.release("a") == 3 and pool.free == 3
+    assert pool.allocation("b") == 1
+
+
+def test_device_pool_fair_growth_reserves_peer_fair_share():
+    pool = DevicePool(8)
+    pool.admit("a", 4)
+    pool.admit("b", 4)
+    assert pool.request("b", 2) == 2                  # shrink: always granted
+    # a wants everything; fair share is 8//2 = 4 and b (at 2) is owed 2
+    # of the 2 free slots — so a cannot grow at all
+    assert pool.request("a", 8) == 4
+    # b recovers to fair share, then a's growth comes only from true surplus
+    assert pool.request("b", 4) == 4
+    pool.release("b")
+    assert pool.request("a", 8) == 8                  # sole job: all of it
+    with pytest.raises(KeyError):
+        pool.request("ghost", 1)
+
+
+# ------------------------------------------- resize engine (no real pod)
+
+class _LiveProc:
+    """Fake Popen handle: alive until terminated/killed."""
+
+    def __init__(self, rc=None):
+        self._rc = rc
+
+    def poll(self):
+        return self._rc
+
+    def terminate(self):
+        self._rc = 0 if self._rc is None else self._rc
+
+    def kill(self):
+        self._rc = -9 if self._rc is None else self._rc
+
+    def wait(self, timeout=None):
+        return self._rc
+
+
+def _seal(ckpt_dir, step):
+    d = os.path.join(ckpt_dir, f"step_{step:08d}")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, launch._MANIFEST), "w") as fh:
+        fh.write("{}")
+
+
+def _beat(path, step):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump({"step": step, "ts": time.time(), "process_index": 0}, fh)
+
+
+def _elastic(tmp_path, *, policy=None, **kw):
+    cfg = TrainConfig(output_dir=str(tmp_path), run_id="pod")
+    return ElasticSupervisor(cfg, launch.LaunchConfig(**kw),
+                             str(tmp_path / "pod"), policy=policy,
+                             job="pod")
+
+
+def _events(tmp_path):
+    with open(tmp_path / "pod" / "supervisor.jsonl") as fh:
+        return [json.loads(line) for line in fh]
+
+
+def test_direct_refuses_out_of_bounds_without_geometry_change(tmp_path):
+    sup = _elastic(tmp_path, nprocs=2,
+                   policy=ResizePolicy(min_nprocs=1, max_nprocs=4))
+    try:
+        spec = sup._worker_spec(resume=None)
+        assert sup._direct(9, "operator", spec) is False
+        assert sup.target_nprocs == 2 and not sup._resize_pending()
+        assert sup.resizes == 0
+    finally:
+        sup.bus.close()
+    aborts = [r for r in _events(tmp_path) if r["event"] == "resize_abort"]
+    assert aborts and aborts[0]["reason"] == "bounds:operator"
+    assert (aborts[0]["from_nprocs"], aborts[0]["to_nprocs"]) == (2, 9)
+
+
+def test_direct_same_width_is_not_an_incident(tmp_path):
+    sup = _elastic(tmp_path, nprocs=2)
+    try:
+        spec = sup._worker_spec(resume=None)
+        assert sup._direct(2, "operator", spec) is False
+        assert sup.resizes == 0
+    finally:
+        sup.bus.close()
+    assert all(r["event"] != "resize_abort" for r in _events(tmp_path))
+
+
+def test_direct_enforces_resize_budget(tmp_path):
+    sup = _elastic(tmp_path, nprocs=2, policy=ResizePolicy(max_resizes=0))
+    try:
+        spec = sup._worker_spec(resume=None)
+        assert sup._direct(3, "operator", spec) is False
+    finally:
+        sup.bus.close()
+    aborts = [r for r in _events(tmp_path) if r["event"] == "resize_abort"]
+    assert aborts and aborts[0]["reason"] == "resize_budget:operator"
+
+
+def test_direct_accept_publishes_begin_and_queues_directive(tmp_path):
+    sup = _elastic(tmp_path, nprocs=2,
+                   policy=ResizePolicy(step_budget=50, wall_budget_s=60.0))
+    try:
+        spec = sup._worker_spec(resume=None)
+        _beat(spec["heartbeats"][0], 7)
+        assert sup._direct(4, "operator", spec) is True
+        assert sup._resize_pending() and sup.resizes == 1
+        assert sup.target_nprocs == 2        # uncommitted until applied
+    finally:
+        sup.bus.close()
+    begin = [r for r in _events(tmp_path) if r["event"] == "resize_begin"]
+    assert len(begin) == 1
+    assert begin[0]["from_nprocs"] == 2 and begin[0]["to_nprocs"] == 4
+    assert begin[0]["reason"] == "operator" and begin[0]["step"] == 7
+    assert begin[0]["step_budget"] == 50
+    assert begin[0]["job"] == "pod" and begin[0]["process_index"] == -1
+
+
+def test_apply_resize_commits_within_step_budget(tmp_path):
+    sup = _elastic(tmp_path, nprocs=2, policy=ResizePolicy(step_budget=5))
+    try:
+        _seal(sup.ckpt_dir, 4)
+        spec = sup._worker_spec(resume=None)
+        sup._direct(3, "operator", spec)
+        directive = sup._take_resize()
+        assert sup._apply_resize(directive, progress_step=6) is True
+        assert sup.target_nprocs == 3
+        assert sup._inflight["committed"] \
+            and sup._inflight["steps_lost"] == 2
+    finally:
+        sup.bus.close()
+
+
+def test_apply_resize_aborts_over_step_budget(tmp_path):
+    sup = _elastic(tmp_path, nprocs=2, policy=ResizePolicy(step_budget=5))
+    try:
+        _seal(sup.ckpt_dir, 4)
+        spec = sup._worker_spec(resume=None)
+        sup._direct(3, "operator", spec)
+        directive = sup._take_resize()
+        assert sup._apply_resize(directive, progress_step=100) is False
+        assert sup.target_nprocs == 2        # old width: resize refused
+        assert sup._inflight is None
+    finally:
+        sup.bus.close()
+    aborts = [r for r in _events(tmp_path) if r["event"] == "resize_abort"]
+    assert aborts and aborts[-1]["reason"] == "step_budget"
+    assert aborts[-1]["steps_lost"] == 96
+
+
+def test_post_spawn_commits_when_every_worker_heartbeats(tmp_path):
+    sup = _elastic(tmp_path, nprocs=2)
+    try:
+        _seal(sup.ckpt_dir, 4)
+        spec = sup._worker_spec(resume=None)
+        sup._direct(3, "operator", spec)
+        assert sup._apply_resize(sup._take_resize(), 4) is True
+        new_spec = sup._worker_spec(resume=sup.ckpt_dir)
+        assert len(new_spec["heartbeats"]) == 3       # re-specced at 3
+        for path in new_spec["heartbeats"]:
+            _beat(path, 4)
+        sup._post_spawn([_LiveProc() for _ in range(3)], new_spec)
+        assert sup.resizes_committed == 1 and sup._inflight is None
+    finally:
+        sup.bus.close()
+    commits = [r for r in _events(tmp_path) if r["event"] == "resize_commit"]
+    assert len(commits) == 1
+    rec = commits[0]
+    assert rec["from_nprocs"] == 2 and rec["to_nprocs"] == 3
+    assert rec["steps_lost"] == 0 and rec["checkpoint"].endswith(
+        "step_00000004")
+
+
+def test_post_spawn_wall_budget_abort_reverts_to_old_width(tmp_path):
+    sup = _elastic(tmp_path, nprocs=2,
+                   policy=ResizePolicy(wall_budget_s=0.0))
+    sup.launch.poll_s = 0.01
+    try:
+        _seal(sup.ckpt_dir, 4)
+        spec = sup._worker_spec(resume=None)
+        sup._direct(4, "operator", spec)
+        assert sup._apply_resize(sup._take_resize(), 4) is True
+        new_spec = sup._worker_spec(resume=sup.ckpt_dir)
+        # no heartbeats ever appear: the new mesh never arms
+        sup._post_spawn([_LiveProc() for _ in range(4)], new_spec)
+        assert sup._inflight is None
+        # revert queued back to the pre-resize width
+        assert sup._take_resize() == (2, "revert")
+    finally:
+        sup.bus.close()
+    aborts = [r for r in _events(tmp_path) if r["event"] == "resize_abort"]
+    assert aborts and aborts[-1]["reason"] == "wall_budget"
+    assert "duration_s" in aborts[-1]
+
+
+def test_post_spawn_arm_failure_aborts_without_revert(tmp_path):
+    sup = _elastic(tmp_path, nprocs=2)
+    try:
+        _seal(sup.ckpt_dir, 4)
+        spec = sup._worker_spec(resume=None)
+        sup._direct(4, "operator", spec)
+        assert sup._apply_resize(sup._take_resize(), 4) is True
+        new_spec = sup._worker_spec(resume=sup.ckpt_dir)
+        procs = [_LiveProc(), _LiveProc(-9), _LiveProc(), _LiveProc()]
+        sup._post_spawn(procs, new_spec)
+        # the watch loop's loss path owns recovery (relaunch-budgeted)
+        assert not sup._resize_pending()
+    finally:
+        sup.bus.close()
+    aborts = [r for r in _events(tmp_path) if r["event"] == "resize_abort"]
+    assert aborts and aborts[-1]["reason"] == "arm_failed"
+
+
+def test_poll_tick_consumes_control_commands(tmp_path):
+    sup = _elastic(tmp_path, nprocs=2)
+    try:
+        spec = sup._worker_spec(resume=None)
+        ControlPlane.write(sup.control.path, {"cmd": "resize", "nprocs": 3})
+        sup._poll_tick([_LiveProc(), _LiveProc()], spec)
+        assert sup._resize_pending()
+        assert sup._take_resize() == (3, "operator")
+        ControlPlane.write(sup.control.path, {"cmd": "stop"})
+        sup._poll_tick([_LiveProc(), _LiveProc()], spec)
+        assert sup._shutdown.is_set()
+    finally:
+        sup.bus.close()
+
+
+def test_poll_tick_drain_waits_out_grace_then_shrinks(tmp_path):
+    sup = _elastic(tmp_path, nprocs=2,
+                   policy=ResizePolicy(drain_grace_s=0.0))
+    try:
+        spec = sup._worker_spec(resume=None)
+        procs = [_LiveProc(0), _LiveProc()]           # one drained, one live
+        sup._poll_tick(procs, spec)                   # arms the grace clock
+        assert not sup._resize_pending()
+        sup._poll_tick(procs, spec)                   # grace (0s) elapsed
+        assert sup._take_resize() == (1, "preemption")
+    finally:
+        sup.bus.close()
+    begin = [r for r in _events(tmp_path) if r["event"] == "resize_begin"]
+    assert begin and begin[0]["reason"] == "preemption"
+
+
+def test_elastic_reconcile_full_loop_over_fake_pod(tmp_path):
+    """End-to-end through the REAL run() loop with fake processes: a
+    scripted grow at step 0 executes begin -> teardown -> re-spec at 3
+    -> arm -> commit, then the generation completes and run() exits 0."""
+    sup = _elastic(tmp_path, nprocs=2, max_relaunches=2, poll_s=0.01)
+    sup._schedule = [(0, 3)]
+    _seal(sup.ckpt_dir, 4)
+    spawned = []
+
+    def fake_spawn(spec):
+        n = int(spec["nprocs"])
+        spawned.append(n)
+        for path in spec["heartbeats"]:
+            _beat(path, 4)
+        # gen 0 stays live (so the schedule can interrupt the watch);
+        # gen 1 is already complete (rc 0 everywhere) -> outcome "ok"
+        return [_LiveProc(None if len(spawned) == 1 else 0)
+                for _ in range(n)]
+
+    sup._spawn = fake_spawn
+    assert sup.run() == 0
+    assert spawned == [2, 3]
+    assert sup.resizes == 1 and sup.resizes_committed == 1
+    assert sup.target_nprocs == 3
+    events = [r["event"] for r in _events(tmp_path)]
+    # begin brackets the change; relaunch marks the new generation; the
+    # commit lands only after that generation armed (all heartbeats)
+    assert events.index("resize_begin") \
+        < events.index("worker_relaunch") < events.index("resize_commit")
+    relaunch = [r for r in _events(tmp_path)
+                if r["event"] == "worker_relaunch"]
+    assert relaunch[0]["nprocs"] == 3
+    # the pod's own stream strict-validates with the resize records in it
+    assert telemetry_cli(["validate",
+                          str(tmp_path / "pod" / "supervisor.jsonl"),
+                          "--strict"]) == 0
+
+
+# -------------------------------------------------- events + health wiring
+
+def test_resize_and_job_events_validate_on_a_strict_bus():
+    mem = MemoryExporter()
+    bus = EventBus([mem], validate=True)
+    bus.publish({"event": "resize_begin", "job": "a", "reason": "operator",
+                 "from_nprocs": 2, "to_nprocs": 4, "generation": 1,
+                 "step": 10, "step_budget": 50, "wall_budget_s": 600.0})
+    bus.publish({"event": "resize_commit", "job": "a", "from_nprocs": 2,
+                 "to_nprocs": 4, "generation": 1,
+                 "checkpoint": "ckpt/step_00000008", "duration_s": 3.5,
+                 "steps_lost": 2, "reason": "operator"})
+    bus.publish({"event": "resize_abort", "job": "a", "reason": "wall_budget",
+                 "from_nprocs": 2, "to_nprocs": 4, "generation": 2,
+                 "duration_s": 600.1})
+    bus.publish({"event": "job_admit", "job": "a", "nprocs": 2,
+                 "devices_free": 6})
+    bus.publish({"event": "job_done", "job": "a", "outcome": "ok",
+                 "exit_code": 0, "generations": 3, "resizes": 2})
+    bus.close()
+    assert [r["event"] for r in mem.records] == [
+        "resize_begin", "resize_commit", "resize_abort",
+        "job_admit", "job_done"]
+
+
+def test_health_attributes_resize_incidents():
+    mon = HealthMonitor()
+    mon.emit({"event": "resize_begin", "job": "a", "reason": "operator",
+              "from_nprocs": 2, "to_nprocs": 4, "generation": 1})
+    v = mon.tick(2)
+    assert v["state"] == "degraded" and CAUSE_RESIZE in v["causes"]
+    assert v["evidence"][CAUSE_RESIZE]["resizes"] == 1
+    mon.emit({"event": "resize_abort", "job": "a", "reason": "wall_budget",
+              "from_nprocs": 2, "to_nprocs": 4, "generation": 1})
+    v = mon.tick(4)
+    assert v["state"] == "critical"
+    assert v["evidence"][CAUSE_RESIZE]["resize_aborts"] == 1
+
+
+def test_replay_health_ticks_on_resize_events():
+    from gaussiank_sgd_tpu.telemetry import replay_health
+    stream = [{"event": "resize_begin", "job": "a", "reason": "preemption",
+               "from_nprocs": 4, "to_nprocs": 3, "generation": 2}]
+    replayed, mon = replay_health(stream)
+    assert any(CAUSE_RESIZE in r["causes"] for r in replayed)
+    assert mon.summary()["worst_state"] == "degraded"
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read().decode()
+
+
+def test_health_server_routes_by_job_id():
+    healthy, broken = HealthMonitor(), HealthMonitor()
+    broken.emit({"event": "worker_lost", "generation": 0, "worker": 1,
+                 "reason": "exit", "exit_code": -9})
+    broken.tick(2)
+    srv = HealthServer(None).start()              # scheduler mode
+    try:
+        srv.add_job("good", healthy)
+        srv.add_job("bad", broken)
+        base = f"http://127.0.0.1:{srv.port}"
+        code, body = _get(f"{base}/healthz/good")
+        assert code == 200 and json.loads(body)["state"] == "ok"
+        code, body = _get(f"{base}/healthz/bad")
+        assert code == 503 and json.loads(body)["state"] == "critical"
+        assert _get(f"{base}/healthz/ghost")[0] == 404
+        # bare /healthz aggregates the worst job, statuses inline
+        code, body = _get(f"{base}/healthz")
+        agg = json.loads(body)
+        assert code == 503 and agg["state"] == "critical"
+        assert set(agg["jobs"]) == {"good", "bad"}
+        # per-job prometheus lines
+        code, body = _get(f"{base}/metrics")
+        assert code == 200
+        assert 'health_state{job="bad"} 2' in body
+        assert 'health_state{job="good"} 0' in body
+        assert _get(f"{base}/metrics/bad") == (200, "health_state 2\n")
+        assert _get(f"{base}/metrics/ghost")[0] == 404
+        srv.remove_job("bad")
+        assert _get(f"{base}/healthz/bad")[0] == 404
+    finally:
+        srv.close()
+
+
+def test_health_server_single_monitor_routes_unchanged():
+    mon = HealthMonitor()
+    srv = HealthServer(mon).start()
+    try:
+        code, body = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert code == 200 and json.loads(body)["state"] == "ok"
+        code, body = _get(f"http://127.0.0.1:{srv.port}/metrics")
+        assert code == 200 and body.startswith("health_state 0")
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------------------- scheduler
+
+def _cfg(tmp_path, run_id):
+    return TrainConfig(output_dir=str(tmp_path), run_id=run_id)
+
+
+def test_scheduler_admits_runs_and_releases(tmp_path, monkeypatch):
+    monkeypatch.setattr(scheduler_mod.ElasticSupervisor, "run",
+                        lambda self: 0)
+    sched = JobScheduler(4, str(tmp_path / "pool"), health_port=0)
+    job_a = sched.submit("a", _cfg(tmp_path, "a"),
+                         launch.LaunchConfig(nprocs=2))
+    job_b = sched.submit("b", _cfg(tmp_path, "b"),
+                         launch.LaunchConfig(nprocs=2))
+    assert sched.wait(timeout=30)
+    assert job_a.exit_code == 0 and job_a.outcome == "ok"
+    assert job_b.exit_code == 0
+    assert sched.pool.free == 4                       # all released
+    # per-job health routes were registered on the shared server
+    assert _get(f"http://127.0.0.1:{sched.server.port}/healthz/a")[0] == 200
+    sched.close()
+    with open(tmp_path / "pool" / "scheduler.jsonl") as fh:
+        recs = [json.loads(line) for line in fh]
+    admits = [r for r in recs if r["event"] == "job_admit"]
+    dones = [r for r in recs if r["event"] == "job_done"]
+    assert [r["job"] for r in admits] == ["a", "b"]
+    assert admits[0]["nprocs"] == 2 and admits[0]["devices_free"] == 2
+    assert sorted(r["job"] for r in dones) == ["a", "b"]
+    assert all(r["outcome"] == "ok" and r["exit_code"] == 0 for r in dones)
+
+
+def test_scheduler_refuses_admission_below_policy_floor(tmp_path):
+    sched = JobScheduler(2, str(tmp_path / "pool"))
+    try:
+        with pytest.raises(RuntimeError, match="not admitted"):
+            sched.submit("big", _cfg(tmp_path, "big"),
+                         launch.LaunchConfig(nprocs=4),
+                         policy=ResizePolicy(min_nprocs=3))
+        assert sched.pool.free == 2                   # nothing leaked
+        assert sched.jobs() == []
+        with pytest.raises(ValueError):
+            DevicePool(0)
+    finally:
+        sched.close()
+
+
+def test_scheduler_resize_routes_through_pool_fairness(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setattr(
+        scheduler_mod.ElasticSupervisor, "run",
+        lambda self: 143 if self._shutdown.wait(30) else 1)
+    sched = JobScheduler(8, str(tmp_path / "pool"))
+    sched.submit("a", _cfg(tmp_path, "a"), launch.LaunchConfig(nprocs=4))
+    sched.submit("b", _cfg(tmp_path, "b"), launch.LaunchConfig(nprocs=4))
+    try:
+        assert sched.resize("b", 2) == 2              # shrink granted
+        # a's grow capped: the 2 freed slots are b's fair-share reserve
+        assert sched.resize("a", 8) == 4
+        job_a = sched.job("a")
+        assert not job_a.supervisor._resize_pending()  # width unchanged
+        with pytest.raises(KeyError):
+            sched.resize("ghost", 2)
+    finally:
+        sched.close()
+    assert sched.job("a").exit_code == 143            # graceful drain
+
+
+# ===================================================== slow: chaos runs
+
+def _service_cmd(out_dir, run_id, **over):
+    flags = {"nprocs": 2, "grace": 15, "max-relaunches": 3,
+             "heartbeat-timeout": 300, "max-nprocs": 8,
+             "resize-step-budget": 10, "resize-wall-budget": 900,
+             "dnn": "mnistnet", "dataset": "mnist", "batch-size": 8,
+             "nworkers": 2, "lr": 0.05, "epochs": 1, "max-steps": 12,
+             "compressor": "gaussian", "density": 0.01,
+             "compress-warmup-steps": 2, "warmup-epochs": 0,
+             "save-every-steps": 2, "save-every-epochs": 0,
+             "log-every": 2, "eval-max-batches": 2,
+             "output-dir": out_dir, "run-id": run_id, "seed": 0}
+    resize_at = over.pop("resize_at", [])
+    flags.update(over)
+    cmd = [sys.executable, "-m", "gaussiank_sgd_tpu.service"]
+    for k, v in flags.items():
+        if v is not None:
+            cmd += [f"--{k}", str(v)]
+    for sched_point in resize_at:
+        cmd += ["--resize-at", sched_point]
+    return cmd
+
+
+def _run_service(tmp_path, run_id, timeout=2400, **over):
+    env = dict(os.environ)
+    env.pop("GKSGD_FORCE_VIRTUAL_CPU", None)
+    proc = subprocess.run(_service_cmd(str(tmp_path), run_id, **over),
+                          env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+    return proc, os.path.join(str(tmp_path), run_id)
+
+
+def _resize_records(pod):
+    with open(os.path.join(pod, "supervisor.jsonl")) as fh:
+        recs = [json.loads(line) for line in fh]
+    return ([r for r in recs if r["event"] == "resize_begin"],
+            [r for r in recs if r["event"] == "resize_commit"],
+            [r for r in recs if r["event"] == "resize_abort"])
+
+
+def _final_loss(pod, proc_index=0):
+    path = os.path.join(pod, f"proc{proc_index:03d}", "metrics.jsonl")
+    trains = [json.loads(line) for line in open(path)
+              if '"event": "train"' in line]
+    return trains[-1]["loss"]
+
+
+@slow
+@run_slow
+def test_service_n2_grow_shrink_smoke(tmp_path):
+    """CI smoke (N=2->3->2): scripted operator grow + shrink both commit
+    inside their budgets, the run exits 0, and the supervisor stream
+    (with the resize brackets in it) strict-validates."""
+    proc, pod = _run_service(tmp_path, "smoke",
+                             resize_at=["4:3", "8:2"])
+    assert proc.returncode == 0, proc.stderr[-4000:] + proc.stdout[-2000:]
+    begins, commits, aborts = _resize_records(pod)
+    assert [(r["from_nprocs"], r["to_nprocs"]) for r in commits] \
+        == [(2, 3), (3, 2)], (begins, commits, aborts)
+    assert all(r["steps_lost"] <= 10 for r in commits)
+    assert telemetry_cli(["validate",
+                          os.path.join(pod, "supervisor.jsonl"),
+                          "--strict"]) == 0
+    # the health monitor attributed both geometry changes
+    assert telemetry_cli(["health",
+                          os.path.join(pod, "supervisor.jsonl")]) in (1, 2)
+
+
+@slow
+@run_slow
+def test_service_chaos_acceptance_n2_4_2(tmp_path):
+    """ISSUE 18 acceptance: one job survives N=2->4->2 — a worker
+    SIGKILL mid-step (same-width relaunch; the chaos env arms generation
+    0 only, so it lands before the first re-mesh), an operator grow to
+    4, and a shrink back to 2 — every resize inside its step budget,
+    exit 0, merged-stream loss on the dense-parity band of a clean N=2
+    run."""
+    clean, pod_c = _run_service(tmp_path, "clean")
+    assert clean.returncode == 0, clean.stderr[-4000:]
+
+    chaotic, pod_k = _run_service(
+        tmp_path, "chaos", resize_at=["5:4", "9:2"],
+        **{"kill-step": 3, "kill-proc": 1})
+    assert chaotic.returncode == 0, \
+        chaotic.stderr[-4000:] + chaotic.stdout[-2000:]
+
+    begins, commits, aborts = _resize_records(pod_k)
+    assert [(r["from_nprocs"], r["to_nprocs"]) for r in commits] \
+        == [(2, 4), (4, 2)], (begins, commits, aborts)
+    assert all(r["steps_lost"] <= 10 for r in commits)
+
+    with open(os.path.join(pod_k, "supervisor.jsonl")) as fh:
+        sup = [json.loads(line) for line in fh]
+    assert any(r["event"] == "worker_lost" for r in sup)
+
+    # merged pod stream (all four worker slots existed at some point)
+    merged = os.path.join(pod_k, "merged.jsonl")
+    streams = [os.path.join(pod_k, f"proc{i:03d}", "metrics.jsonl")
+               for i in range(4)
+               if os.path.exists(os.path.join(pod_k, f"proc{i:03d}",
+                                              "metrics.jsonl"))]
+    assert telemetry_cli(["merge", *streams,
+                          os.path.join(pod_k, "supervisor.jsonl"),
+                          "-o", merged, "--strict"]) == 0
+    loss_c, loss_k = _final_loss(pod_c), _final_loss(pod_k)
+    assert abs(loss_k - loss_c) <= max(0.25 * abs(loss_c), 0.5), \
+        (loss_c, loss_k)
